@@ -1,0 +1,20 @@
+"""karpenter_tpu — a TPU-native node-provisioning autoscaler framework.
+
+A ground-up re-design of the capabilities of raghibfaisal/karpenter
+(Kubernetes node autoscaling: pod→instance-type bin-packing, consolidation,
+interruption handling, cloud actuation) where the scheduling and
+consolidation hot paths are batched pods×instance-types assignment problems
+solved by jit-compiled JAX kernels on TPU, instead of per-pod greedy loops.
+
+Layer map (mirrors SURVEY.md §1, re-architected):
+  api/         CRD-analog data model (NodePool, NodeClaim, NodeClass, Pod)
+  catalog/     instance types, offerings, pricing, overhead math
+  ops/         tensorization + solver kernels (FFD scan, relaxed-LP) — the TPU hot path
+  parallel/    device-mesh sharding of the assignment problem
+  state/       cluster-state cache the simulator packs against
+  controllers/ reconcile loops (provisioning, disruption, interruption, GC, nodeclass)
+  cloud/       capacity-provider substrate (provider seam, fake cloud, batcher, caches)
+  utils/       shared helpers
+"""
+
+__version__ = "0.1.0"

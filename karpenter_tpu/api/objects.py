@@ -1,0 +1,227 @@
+"""Core API objects: Pod, Node, NodeClaim, NodePool, NodeClass.
+
+These are the Python analogs of the reference's CRD surface:
+  - NodePool / NodeClaim — karpenter-core `apis/v1beta1` (CRDs vendored at
+    /root/reference/pkg/apis/crds/karpenter.sh_nodepools.yaml)
+  - NodeClass — the provider config CRD, analog of EC2NodeClass
+    (/root/reference/pkg/apis/v1beta1/ec2nodeclass.go:30-113)
+  - Pod — just the scheduling-relevant projection of a K8s Pod.
+
+Plain dataclasses; all device-side math happens on tensorized projections of
+these (karpenter_tpu.ops.tensorize), never on the objects themselves.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+from . import labels as wk
+from .requirements import IN, Requirement, Requirements
+from .resources import ResourceList
+from .taints import Taint, Toleration
+
+_ids = itertools.count()
+
+
+def _uid(prefix: str) -> str:
+    return f"{prefix}-{next(_ids):08x}"
+
+
+# ---------------------------------------------------------------------------
+# Pod-side scheduling constraints
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TopologySpreadConstraint:
+    """K8s topologySpreadConstraint (reference scheduling surface:
+    /root/reference/website/content/en/docs/concepts/scheduling.md topology
+    section). Only the scheduler-relevant fields."""
+    topology_key: str                    # zone / hostname / capacity-type
+    max_skew: int = 1
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    min_domains: Optional[int] = None
+
+
+@dataclass
+class PodAffinityTerm:
+    """Pod (anti-)affinity term over a topology domain."""
+    topology_key: str
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    anti: bool = False
+    required: bool = True
+
+
+@dataclass
+class Pod:
+    name: str = ""
+    namespace: str = "default"
+    requests: ResourceList = field(default_factory=ResourceList)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # Required node-affinity: list of OR'd terms, each term a Requirements AND-set.
+    required_affinity_terms: List[Requirements] = field(default_factory=list)
+    preferred_affinity_terms: List[Tuple[int, Requirements]] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    pod_affinities: List[PodAffinityTerm] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    priority: int = 0
+    deletion_cost: int = 0               # pod-deletion-cost annotation analog
+    owner_kind: str = "ReplicaSet"       # "" == ownerless (blocks consolidation)
+    node_name: str = ""                  # bound node ("" == pending)
+    uid: str = field(default_factory=lambda: _uid("pod"))
+
+    DO_NOT_DISRUPT = "karpenter.sh/do-not-disrupt"
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = self.uid
+
+    def scheduling_requirements(self) -> List[Requirements]:
+        """nodeSelector ∧ (OR over required affinity terms), each branch a
+        Requirements set — the pod-side input to compatibility masking."""
+        base = Requirements.from_labels(self.node_selector)
+        if not self.required_affinity_terms:
+            return [base]
+        return [base.union(term) for term in self.required_affinity_terms]
+
+    @property
+    def do_not_disrupt(self) -> bool:
+        return self.annotations.get(self.DO_NOT_DISRUPT, "") == "true"
+
+
+# ---------------------------------------------------------------------------
+# NodePool / NodeClass / NodeClaim / Node
+# ---------------------------------------------------------------------------
+
+@dataclass
+class KubeletConfiguration:
+    """Pod-density knobs (karpenter-core v1beta1 KubeletConfiguration; feeds
+    the max-pods math at /root/reference/pkg/providers/instancetype/types.go:401-416)."""
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    kube_reserved: ResourceList = field(default_factory=ResourceList)
+    system_reserved: ResourceList = field(default_factory=ResourceList)
+    eviction_hard: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class Disruption:
+    """NodePool .spec.disruption block (consolidation policy / expiry)."""
+    consolidation_policy: str = "WhenUnderutilized"  # or WhenEmpty
+    consolidate_after_s: Optional[float] = None       # required for WhenEmpty
+    expire_after_s: Optional[float] = None            # None == Never
+
+
+@dataclass
+class NodeClass:
+    """Provider config — analog of EC2NodeClass
+    (/root/reference/pkg/apis/v1beta1/ec2nodeclass.go:30-113). Selector terms
+    resolve against the fake/real cloud into concrete zones/subnets/images;
+    resolved state lives in `.status` like the reference's nodeclass
+    controller writes (/root/reference/pkg/controllers/nodeclass/controller.go:73-99)."""
+    name: str = "default"
+    image_family: str = "standard"       # amiFamily analog
+    zone_selector: List[str] = field(default_factory=list)  # [] == all zones
+    subnet_selector: Dict[str, str] = field(default_factory=dict)
+    security_group_selector: Dict[str, str] = field(default_factory=dict)
+    role: str = ""
+    user_data: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+    block_device_gib: int = 20
+    # resolved status (set by the nodeclass controller)
+    status_zones: List[str] = field(default_factory=list)
+    status_subnets: List[str] = field(default_factory=list)
+    status_security_groups: List[str] = field(default_factory=list)
+    status_images: List[str] = field(default_factory=list)
+    status_instance_profile: str = ""
+    hash_annotation: str = ""
+
+
+@dataclass
+class NodePoolTemplate:
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    requirements: Requirements = field(default_factory=Requirements)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    node_class_ref: str = "default"
+    kubelet: KubeletConfiguration = field(default_factory=KubeletConfiguration)
+
+
+@dataclass
+class NodePool:
+    name: str = "default"
+    template: NodePoolTemplate = field(default_factory=NodePoolTemplate)
+    disruption: Disruption = field(default_factory=Disruption)
+    limits: ResourceList = field(default_factory=ResourceList)  # empty == unlimited
+    weight: int = 0
+
+    def requirements(self) -> Requirements:
+        return Requirements.from_labels(self.template.labels).union(
+            self.template.requirements).union(
+            Requirements.of(Requirement(wk.NODEPOOL, IN, [self.name])))
+
+    def within_limits(self, in_use: ResourceList) -> bool:
+        """NodePool-level resource caps (designs/limits.md)."""
+        return all(in_use.get(k, 0) < v for k, v in self.limits.items()) if self.limits else True
+
+
+@dataclass
+class NodeClaim:
+    """The unit of provisioning: scheduler emits it, cloud provider fulfils it
+    (consumed by Create at /root/reference/pkg/cloudprovider/cloudprovider.go:92-118)."""
+    nodepool: str
+    requirements: Requirements = field(default_factory=Requirements)
+    requests: ResourceList = field(default_factory=ResourceList)
+    taints: List[Taint] = field(default_factory=list)
+    node_class_ref: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    name: str = field(default_factory=lambda: _uid("nodeclaim"))
+    # lifecycle (launch → registered → initialized), §2.2 NodeClaim lifecycle
+    provider_id: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    price: float = 0.0
+    launched_at: float = 0.0
+    registered: bool = False
+    initialized: bool = False
+    terminating: bool = False
+
+    @property
+    def launched(self) -> bool:
+        return bool(self.provider_id)
+
+
+@dataclass
+class Node:
+    """Cluster-state view of a live node (karpenter-core state.Cluster node)."""
+    name: str
+    provider_id: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    allocatable: ResourceList = field(default_factory=ResourceList)
+    capacity: ResourceList = field(default_factory=ResourceList)
+    pods: List[Pod] = field(default_factory=list)
+    nodepool: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    price: float = 0.0
+    created_at: float = field(default_factory=time.time)
+    nominated_until: float = 0.0         # in-flight pod nominations block disruption
+    marked_for_deletion: bool = False
+
+    def requested(self) -> ResourceList:
+        out = ResourceList()
+        for p in self.pods:
+            out = out + p.requests
+        return out
+
+    def available(self) -> ResourceList:
+        return (self.allocatable - self.requested()).clamp_nonnegative()

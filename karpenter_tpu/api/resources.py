@@ -1,0 +1,147 @@
+"""Resource quantities and resource-list arithmetic.
+
+TPU-native re-design of the reference's resource handling
+(karpenter-core `utils/resources`; consumed at
+/root/reference/pkg/cloudprovider/cloudprovider.go:264 via `resources.Fits`).
+
+Design notes (TPU-first): every ResourceList can be lowered to a fixed-order
+dense vector (`to_vector`) so that pod batches and instance-type catalogs
+become `P×R` / `T×R` matrices consumed by the JAX solver kernels in
+`karpenter_tpu.ops`. Canonical integer units (millicores / bytes / counts)
+keep the host-side math exact; the device-side kernels work in float32/bf16.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterable, Mapping, Sequence, Tuple
+
+# Canonical resource names (K8s conventions).
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+PODS = "pods"
+GPU = "gpu.karpenter.tpu/accelerator"  # extended accelerator resource (ref: nvidia.com/gpu)
+NEURON = "gpu.karpenter.tpu/inferentia"  # second accelerator class (ref: aws.amazon.com/neuron)
+POD_ENI = "networking.karpenter.tpu/pod-eni"  # branch network interfaces (ref: vpc.amazonaws.com/pod-eni)
+
+# Default dense axis order for tensorization.  The first four are always
+# present on every instance type; accelerator axes are included so GPU
+# bin-packing (BASELINE.json config 3) needs no axis renegotiation.
+DEFAULT_AXES: Tuple[str, ...] = (CPU, MEMORY, EPHEMERAL_STORAGE, PODS, GPU, NEURON, POD_ENI)
+
+_QUANTITY_RE = re.compile(r"^([+-]?\d+(?:\.\d+)?)([a-zA-Z]*)$")
+
+# Binary and decimal suffix multipliers (K8s resource.Quantity semantics).
+_SUFFIX = {
+    "": 1,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+}
+
+
+def parse_quantity(value, resource: str = MEMORY) -> int:
+    """Parse a K8s-style quantity into canonical integer units.
+
+    cpu → millicores ("1" → 1000, "100m" → 100); everything else → base units
+    (bytes for memory/storage, counts for pods/accelerators).
+    """
+    if isinstance(value, (int, float)):
+        return int(value * 1000) if resource == CPU else int(value)
+    s = str(value).strip()
+    m = _QUANTITY_RE.match(s)
+    if not m:
+        raise ValueError(f"unparseable quantity {value!r}")
+    num, suffix = float(m.group(1)), m.group(2)
+    if resource == CPU:
+        if suffix == "m":
+            return int(num)
+        if suffix == "":
+            return int(num * 1000)
+        raise ValueError(f"unsupported cpu suffix {suffix!r}")
+    if suffix == "m":  # milli-units of a count resource
+        return int(num / 1000)
+    if suffix not in _SUFFIX:
+        raise ValueError(f"unsupported suffix {suffix!r} in {value!r}")
+    return int(num * _SUFFIX[suffix])
+
+
+def format_quantity(units: int, resource: str) -> str:
+    if resource == CPU:
+        return f"{units}m" if units % 1000 else str(units // 1000)
+    if resource in (MEMORY, EPHEMERAL_STORAGE):
+        for suf, mult in (("Ti", 2**40), ("Gi", 2**30), ("Mi", 2**20), ("Ki", 2**10)):
+            if units and units % mult == 0:
+                return f"{units // mult}{suf}"
+    return str(units)
+
+
+class ResourceList(dict):
+    """resource name → canonical integer quantity.
+
+    Mirrors the arithmetic the reference leans on (`resources.Merge`,
+    `resources.Subtract`, `resources.Fits`) but keeps a dense-vector escape
+    hatch for the TPU kernels.
+    """
+
+    @classmethod
+    def parse(cls, spec: Mapping[str, object]) -> "ResourceList":
+        return cls({k: parse_quantity(v, k) for k, v in spec.items()})
+
+    def __missing__(self, key):  # absent resource == zero
+        return 0
+
+    def __add__(self, other: Mapping[str, int]) -> "ResourceList":
+        out = ResourceList(self)
+        for k, v in other.items():
+            out[k] = out.get(k, 0) + v
+        return out
+
+    def __sub__(self, other: Mapping[str, int]) -> "ResourceList":
+        out = ResourceList(self)
+        for k, v in other.items():
+            out[k] = out.get(k, 0) - v
+        return out
+
+    def clamp_nonnegative(self) -> "ResourceList":
+        return ResourceList({k: max(0, v) for k, v in self.items()})
+
+    def fits(self, allocatable: Mapping[str, int]) -> bool:
+        """True iff self (requests) fits within allocatable.
+
+        Semantics of `resources.Fits` at the reference's packing feasibility
+        check (/root/reference/pkg/cloudprovider/cloudprovider.go:264): every
+        requested resource must exist in sufficient quantity; resources the
+        node does not advertise must not be requested.
+        """
+        return all(v <= allocatable.get(k, 0) for k, v in self.items() if v > 0)
+
+    def nonzero(self) -> "ResourceList":
+        return ResourceList({k: v for k, v in self.items() if v != 0})
+
+    def to_vector(self, axes: Sequence[str] = DEFAULT_AXES) -> list:
+        return [float(self.get(a, 0)) for a in axes]
+
+    @classmethod
+    def from_vector(cls, vec: Iterable[float], axes: Sequence[str] = DEFAULT_AXES) -> "ResourceList":
+        return cls({a: int(math.ceil(v)) for a, v in zip(axes, vec) if v})
+
+
+def merge(*lists: Mapping[str, int]) -> ResourceList:
+    out = ResourceList()
+    for rl in lists:
+        out = out + rl
+    return out
+
+
+def pod_requests(containers: Iterable[Mapping[str, int]],
+                 init_containers: Iterable[Mapping[str, int]] = ()) -> ResourceList:
+    """Effective pod request = max(sum(containers), max(initContainers)) per
+    resource — standard K8s semantics the reference's scheduler packs with."""
+    total = merge(*containers)
+    out = ResourceList(total)
+    for ic in init_containers:
+        for k, v in ic.items():
+            out[k] = max(out.get(k, 0), v)
+    return out

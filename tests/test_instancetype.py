@@ -1,0 +1,96 @@
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import KubeletConfiguration
+from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+from karpenter_tpu.api.resources import CPU, EPHEMERAL_STORAGE, GPU, MEMORY, PODS, ResourceList
+from karpenter_tpu.catalog import (GiB, MiB, InstanceTypeInfo, Offering,
+                                   eni_limited_pods, eviction_threshold,
+                                   kube_reserved, max_pods, new_instance_type)
+
+
+def info(**kw):
+    kw.setdefault("name", "m5.xlarge")
+    kw.setdefault("cpu_m", 4000)
+    kw.setdefault("memory_bytes", 16 * GiB)
+    return InstanceTypeInfo(**kw)
+
+
+def offerings():
+    return [Offering("zone-a", "on-demand", 0.192),
+            Offering("zone-a", "spot", 0.07),
+            Offering("zone-b", "on-demand", 0.192, available=False)]
+
+
+def test_eni_limited_pods():
+    # max_enis * (ips_per_eni - 1) + 2  (types.go:304-318)
+    assert eni_limited_pods(info(network_interfaces=4, ips_per_interface=15)) == 58
+    assert eni_limited_pods(info(network_interfaces=4, ips_per_interface=15), reserved_enis=1) == 44
+    assert eni_limited_pods(info(network_interfaces=1, ips_per_interface=15), reserved_enis=1) == 0
+
+
+def test_max_pods_resolution_order():
+    i = info()
+    assert max_pods(i) == 110
+    assert max_pods(i, eni_limited_density=True) == 58
+    assert max_pods(i, KubeletConfiguration(max_pods=42), eni_limited_density=True) == 42
+    assert max_pods(i, KubeletConfiguration(pods_per_core=10)) == 40  # 10 * 4 cores < 110
+
+
+def test_kube_reserved_graduated_cpu():
+    # 6% of first core + 1% of second + 0.5% of cores 3-4 (types.go:342-363)
+    kr = kube_reserved(4000, 110)
+    assert kr[CPU] == 60 + 10 + 10
+    assert kr[MEMORY] == (11 * 110 + 255) * MiB
+    kr2 = kube_reserved(8000, 10)
+    assert kr2[CPU] == 80 + 4000 * 0.0025
+    # kubelet override wins
+    kr3 = kube_reserved(4000, 110, KubeletConfiguration(kube_reserved=ResourceList({CPU: 123})))
+    assert kr3[CPU] == 123
+
+
+def test_eviction_threshold():
+    ev = eviction_threshold(16 * GiB, 20 * GiB)
+    assert ev[MEMORY] == 100 * MiB
+    assert ev[EPHEMERAL_STORAGE] == 2 * GiB
+    ev2 = eviction_threshold(16 * GiB, 20 * GiB,
+                             KubeletConfiguration(eviction_hard=ResourceList({MEMORY: 200 * MiB})))
+    assert ev2[MEMORY] == 200 * MiB
+
+
+def test_new_instance_type_capacity_and_allocatable():
+    it = new_instance_type(info(), offerings(), block_device_gib=20)
+    # memory shaved by 7.5% VM overhead
+    assert it.capacity[MEMORY] == int(16 * GiB * 0.925)
+    assert it.capacity[CPU] == 4000 and it.capacity[PODS] == 110
+    alloc = it.allocatable
+    assert alloc[CPU] == 4000 - 80
+    assert alloc[MEMORY] < it.capacity[MEMORY]
+    assert alloc[PODS] == 110
+
+
+def test_requirements_labels():
+    it = new_instance_type(info(), offerings())
+    r = it.requirements
+    assert r[wk.INSTANCE_TYPE].has("m5.xlarge")
+    assert r[wk.INSTANCE_FAMILY].has("m5")
+    assert r[wk.INSTANCE_SIZE].has("xlarge")
+    assert r[wk.INSTANCE_CPU].has("4")
+    # only *available* offerings contribute zones/capacity-types
+    assert r[wk.ZONE].values == {"zone-a"}
+    assert r[wk.CAPACITY_TYPE].values == {"on-demand", "spot"}
+    # pod requirements match against it
+    pod = Requirements.of(Requirement(wk.INSTANCE_FAMILY, IN, ["m5", "c5"]))
+    assert pod.compatible(r)
+
+
+def test_gpu_capacity():
+    it = new_instance_type(info(name="g5.xlarge", gpu_count=4, gpu_name="a10g",
+                                gpu_memory_bytes=24 * GiB), offerings())
+    assert it.capacity[GPU] == 4
+    assert it.requirements[wk.INSTANCE_GPU_COUNT].has("4")
+
+
+def test_cheapest_offering():
+    it = new_instance_type(info(), offerings())
+    assert it.cheapest_offering().price == 0.07
+    assert it.cheapest_offering(capacity_types={"on-demand"}).price == 0.192
+    assert it.cheapest_offering(zones={"zone-b"}) is None  # unavailable masked

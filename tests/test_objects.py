@@ -1,0 +1,55 @@
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (Disruption, NodePool, NodePoolTemplate,
+                                       Pod)
+from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+from karpenter_tpu.api.resources import CPU, ResourceList
+from karpenter_tpu.api.taints import (NO_EXECUTE, NO_SCHEDULE,
+                                      PREFER_NO_SCHEDULE, Taint, Toleration,
+                                      tolerates_all)
+
+
+def test_tolerations():
+    t = Taint("team", NO_SCHEDULE, "ml")
+    assert Toleration("team", "Equal", "ml").tolerates(t)
+    assert not Toleration("team", "Equal", "other").tolerates(t)
+    assert Toleration("team", "Exists").tolerates(t)
+    assert Toleration(operator="Exists").tolerates(t)  # wildcard
+    assert not Toleration("team", "Exists", effect=NO_EXECUTE).tolerates(t)
+
+
+def test_tolerates_all_prefer_is_soft():
+    taints = [Taint("a", PREFER_NO_SCHEDULE), Taint("b", NO_SCHEDULE)]
+    assert tolerates_all([Toleration("b", "Exists")], taints)
+    assert not tolerates_all([], taints[1:])
+    assert tolerates_all([], taints[:1])
+
+
+def test_pod_scheduling_requirements_or_terms():
+    pod = Pod(node_selector={"x": "1"},
+              required_affinity_terms=[
+                  Requirements.of(Requirement(wk.ZONE, IN, ["zone-a"])),
+                  Requirements.of(Requirement(wk.ZONE, IN, ["zone-b"]))])
+    branches = pod.scheduling_requirements()
+    assert len(branches) == 2
+    for b in branches:
+        assert b["x"].has("1")
+    assert branches[0][wk.ZONE].values == {"zone-a"}
+
+
+def test_nodepool_requirements_and_limits():
+    np = NodePool(name="gpu-pool",
+                  template=NodePoolTemplate(
+                      labels={"team": "ml"},
+                      requirements=Requirements.of(Requirement(wk.CAPACITY_TYPE, IN, ["spot"]))),
+                  limits=ResourceList({CPU: 10_000}))
+    reqs = np.requirements()
+    assert reqs[wk.NODEPOOL].has("gpu-pool")
+    assert reqs["team"].has("ml")
+    assert np.within_limits(ResourceList({CPU: 9_999}))
+    assert not np.within_limits(ResourceList({CPU: 10_000}))
+    assert NodePool().within_limits(ResourceList({CPU: 10**9}))  # no limits == unlimited
+
+
+def test_do_not_disrupt():
+    assert Pod(annotations={Pod.DO_NOT_DISRUPT: "true"}).do_not_disrupt
+    assert not Pod().do_not_disrupt

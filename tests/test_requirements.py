@@ -1,0 +1,88 @@
+from karpenter_tpu.api.requirements import (DOES_NOT_EXIST, EXISTS, GT, IN, LT,
+                                            NOT_IN, Requirement, Requirements)
+from karpenter_tpu.api import labels as wk
+
+
+def test_operator_has():
+    assert Requirement("k", IN, ["a", "b"]).has("a")
+    assert not Requirement("k", IN, ["a"]).has("c")
+    assert Requirement("k", NOT_IN, ["a"]).has("b")
+    assert not Requirement("k", NOT_IN, ["a"]).has("a")
+    assert Requirement("k", EXISTS).has("anything")
+    assert not Requirement("k", DOES_NOT_EXIST).has("anything")
+    assert Requirement("k", GT, ["4"]).has("5")
+    assert not Requirement("k", GT, ["4"]).has("4")
+    assert Requirement("k", LT, ["4"]).has("3")
+    assert not Requirement("k", LT, ["4"]).has("x")
+
+
+def test_intersect_in_in():
+    r = Requirement("k", IN, ["a", "b"]).intersect(Requirement("k", IN, ["b", "c"]))
+    assert r.values == {"b"} and not r.complement
+
+
+def test_intersect_in_notin():
+    r = Requirement("k", IN, ["a", "b"]).intersect(Requirement("k", NOT_IN, ["a"]))
+    assert r.values == {"b"} and not r.complement
+
+
+def test_intersect_notin_notin():
+    r = Requirement("k", NOT_IN, ["a"]).intersect(Requirement("k", NOT_IN, ["b"]))
+    assert r.complement and r.values == {"a", "b"}
+    assert r.has("c") and not r.has("a")
+
+
+def test_intersect_numeric_window():
+    r = Requirement("k", GT, ["2"]).intersect(Requirement("k", LT, ["10"]))
+    assert r.has("5") and not r.has("2") and not r.has("10")
+    # window applied to an In set prunes values
+    r2 = Requirement("k", IN, ["1", "5", "20"]).intersect(Requirement("k", GT, ["2"]))
+    assert r2.values == {"5", "20"}
+
+
+def test_intersects():
+    assert Requirement("k", IN, ["a"]).intersects(Requirement("k", EXISTS))
+    assert not Requirement("k", IN, ["a"]).intersects(Requirement("k", IN, ["b"]))
+
+
+def test_requirements_compatible():
+    # semantics of scheduling.Requirements.Compatible at
+    # pkg/cloudprovider/cloudprovider.go:261-263
+    pod = Requirements.of(Requirement(wk.ZONE, IN, ["zone-a", "zone-b"]),
+                          Requirement(wk.ARCH, IN, ["amd64"]))
+    it = Requirements.of(Requirement(wk.ZONE, IN, ["zone-b"]),
+                         Requirement(wk.ARCH, IN, ["amd64"]),
+                         Requirement(wk.INSTANCE_TYPE, IN, ["m5.large"]))
+    assert pod.compatible(it)
+    pod2 = Requirements.of(Requirement(wk.ZONE, IN, ["zone-c"]))
+    assert not pod2.compatible(it)
+
+
+def test_compatible_undefined_keys():
+    pod = Requirements.of(Requirement("user.io/team", IN, ["ml"]))
+    it = Requirements.of(Requirement(wk.ARCH, IN, ["amd64"]))
+    # undefined key fails closed...
+    assert not pod.compatible(it)
+    # ...unless allow-listed (AllowUndefinedWellKnownLabels analog)
+    assert pod.compatible(it, allow_undefined=["user.io/team"])
+    # ...or complemented (NotIn tolerates absence)
+    assert Requirements.of(Requirement("x", NOT_IN, ["v"])).compatible(it)
+
+
+def test_add_intersects_same_key():
+    rs = Requirements.of(Requirement("k", IN, ["a", "b"]))
+    rs.add(Requirement("k", IN, ["b", "c"]))
+    assert rs["k"].values == {"b"}
+
+
+def test_union_and_labels():
+    a = Requirements.from_labels({"x": "1"})
+    b = Requirements.of(Requirement("y", IN, ["2"]), Requirement("z", EXISTS))
+    u = a.union(b)
+    assert u.labels() == {"x": "1", "y": "2"}
+
+
+def test_min_values_carried():
+    r = Requirement("k", IN, ["a", "b", "c"], min_values=2)
+    r2 = r.intersect(Requirement("k", EXISTS))
+    assert r2.min_values == 2

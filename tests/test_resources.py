@@ -1,0 +1,62 @@
+from karpenter_tpu.api.resources import (CPU, MEMORY, PODS, ResourceList,
+                                         parse_quantity, pod_requests, merge,
+                                         format_quantity)
+
+
+def test_parse_cpu():
+    assert parse_quantity("100m", CPU) == 100
+    assert parse_quantity("1", CPU) == 1000
+    assert parse_quantity("2.5", CPU) == 2500
+    assert parse_quantity(2, CPU) == 2000
+
+
+def test_parse_memory():
+    assert parse_quantity("1Gi", MEMORY) == 2**30
+    assert parse_quantity("256Mi", MEMORY) == 256 * 2**20
+    assert parse_quantity("1G", MEMORY) == 10**9
+    assert parse_quantity("1024", MEMORY) == 1024
+
+
+def test_format_roundtrip():
+    assert format_quantity(1500, CPU) == "1500m"
+    assert format_quantity(2000, CPU) == "2"
+    assert format_quantity(2**30, MEMORY) == "1Gi"
+
+
+def test_arithmetic_and_fits():
+    a = ResourceList.parse({"cpu": "1", "memory": "1Gi"})
+    b = ResourceList.parse({"cpu": "500m", "memory": "512Mi", "pods": 1})
+    s = a + b
+    assert s[CPU] == 1500 and s[PODS] == 1
+    d = a - b
+    assert d[CPU] == 500 and d[PODS] == -1
+    assert d.clamp_nonnegative()[PODS] == 0
+    # fits: request must be covered on every axis; unadvertised resources block
+    alloc = ResourceList.parse({"cpu": "2", "memory": "2Gi", "pods": 10})
+    assert b.fits(alloc)
+    assert not ResourceList.parse({"cpu": "3"}).fits(alloc)
+    assert not ResourceList.parse({"gpu.karpenter.tpu/accelerator": 1}).fits(alloc)
+    # zero-valued requests never block
+    assert ResourceList({"whatever": 0}).fits(alloc)
+
+
+def test_vector_roundtrip():
+    rl = ResourceList.parse({"cpu": "250m", "memory": "128Mi", "pods": 1})
+    vec = rl.to_vector()
+    back = ResourceList.from_vector(vec)
+    assert back[CPU] == 250 and back[MEMORY] == 128 * 2**20 and back[PODS] == 1
+
+
+def test_pod_requests_init_containers():
+    # max(sum(containers), max(initContainers)) per resource
+    got = pod_requests(
+        [ResourceList.parse({"cpu": "100m"}), ResourceList.parse({"cpu": "200m", "memory": "1Gi"})],
+        [ResourceList.parse({"cpu": "1"}), ResourceList.parse({"memory": "512Mi"})],
+    )
+    assert got[CPU] == 1000          # init container dominates
+    assert got[MEMORY] == 2**30      # containers dominate
+
+
+def test_merge():
+    out = merge(ResourceList({CPU: 1}), ResourceList({CPU: 2, MEMORY: 3}))
+    assert out[CPU] == 3 and out[MEMORY] == 3
