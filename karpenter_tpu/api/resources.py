@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import math
 import re
-from typing import Dict, Iterable, Mapping, Sequence, Tuple
+from typing import Dict, Iterable, Mapping, Optional, Sequence, Tuple
 
 # Canonical resource names (K8s conventions).
 CPU = "cpu"
@@ -30,6 +30,11 @@ POD_ENI = "networking.karpenter.tpu/pod-eni"  # branch network interfaces (ref: 
 # present on every instance type; accelerator axes are included so GPU
 # bin-packing (BASELINE.json config 3) needs no axis renegotiation.
 DEFAULT_AXES: Tuple[str, ...] = (CPU, MEMORY, EPHEMERAL_STORAGE, PODS, GPU, NEURON, POD_ENI)
+
+# Device-side unit scaling: byte-valued axes are lowered in MiB so every
+# tensor value stays well inside float32's exact-integer range (2^24) —
+# canonical host units (bytes) would silently lose precision in the kernels.
+DEFAULT_SCALES: Dict[str, float] = {MEMORY: float(2**20), EPHEMERAL_STORAGE: float(2**20)}
 
 _QUANTITY_RE = re.compile(r"^([+-]?\d+(?:\.\d+)?)([a-zA-Z]*)$")
 
@@ -120,12 +125,31 @@ class ResourceList(dict):
     def nonzero(self) -> "ResourceList":
         return ResourceList({k: v for k, v in self.items() if v != 0})
 
-    def to_vector(self, axes: Sequence[str] = DEFAULT_AXES) -> list:
-        return [float(self.get(a, 0)) for a in axes]
+    def to_vector(self, axes: Sequence[str] = DEFAULT_AXES,
+                  scales: Optional[Mapping[str, float]] = None,
+                  round_up: bool = False) -> list:
+        """Dense projection. With `scales`, byte axes are divided down to MiB;
+        `round_up` (requests) vs floor (allocatable) keeps the integer lowering
+        conservative in the solver's favor."""
+        out = []
+        for a in axes:
+            v = float(self.get(a, 0))
+            if scales and a in scales:
+                v /= scales[a]
+                v = math.ceil(v) if round_up else math.floor(v)
+            out.append(float(v))
+        return out
 
     @classmethod
-    def from_vector(cls, vec: Iterable[float], axes: Sequence[str] = DEFAULT_AXES) -> "ResourceList":
-        return cls({a: int(math.ceil(v)) for a, v in zip(axes, vec) if v})
+    def from_vector(cls, vec: Iterable[float], axes: Sequence[str] = DEFAULT_AXES,
+                    scales: Optional[Mapping[str, float]] = None) -> "ResourceList":
+        out = {}
+        for a, v in zip(axes, vec):
+            if scales and a in scales:
+                v *= scales[a]
+            if v:
+                out[a] = int(math.ceil(v))
+        return cls(out)
 
 
 def merge(*lists: Mapping[str, int]) -> ResourceList:

@@ -1,0 +1,6 @@
+from .cache import TTLCache, UnavailableOfferings, UNAVAILABLE_OFFERINGS_TTL
+from .fake import (CloudError, CloudInstance, FakeCloud, FleetError,
+                   FleetOverride, FleetResult, ICE_CODE)
+from .provider import (CloudProvider, InstanceTypesProvider,
+                       InsufficientCapacityError, MAX_INSTANCE_TYPES,
+                       MIN_SPOT_FLEXIBILITY)

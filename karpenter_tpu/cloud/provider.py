@@ -1,0 +1,269 @@
+"""The CloudProvider seam: catalog + actuation.
+
+Re-implements the L3/L2 surface of the reference:
+  * `InstanceTypesProvider` — the solver's catalog with ICE-masked offering
+    availability and seq-num memoization
+    (/root/reference/pkg/providers/instancetype/instancetype.go:89-175,241-278);
+  * `CloudProvider` — the core seam `Create/Delete/Get/List/GetInstanceTypes/
+    IsDrifted` (/root/reference/pkg/cloudprovider/cloudprovider.go:66-229),
+    including the launch path's candidate filtering, price ordering, 60-type
+    cap and capacity-type choice
+    (/root/reference/pkg/providers/instance/instance.go:88-105,197-253,380-424).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as wk
+from ..api.objects import NodeClaim, NodeClass, NodePool
+from ..api.requirements import IN, Requirement, Requirements
+from ..api.resources import ResourceList
+from ..catalog.instancetype import InstanceType, Offering
+from .cache import UnavailableOfferings
+from .fake import CloudError, FakeCloud, FleetOverride, FleetResult, ICE_CODE
+
+# Launch action-space cap (/root/reference/pkg/providers/instance/instance.go:56-57).
+MAX_INSTANCE_TYPES = 60
+MIN_SPOT_FLEXIBILITY = 5  # OD-flexibility warning floor
+
+
+class InsufficientCapacityError(Exception):
+    """All candidate pools ICE'd — the caller retries with a fresh catalog
+    (error taxonomy analog: /root/reference/pkg/errors/errors.go:56-103)."""
+
+
+@dataclass
+class InstanceTypesProvider:
+    """Catalog provider with ICE masking + memoization keyed on the
+    unavailable-offerings sequence number (instancetype.go:114-124)."""
+    base_catalog: List[InstanceType]
+    unavailable: UnavailableOfferings
+    _memo: Tuple[int, List[InstanceType]] = field(default=None, repr=False)
+
+    def list(self) -> List[InstanceType]:
+        seq = self.unavailable.seq_num
+        if self._memo is not None and self._memo[0] == seq:
+            return self._memo[1]
+        out = []
+        for it in self.base_catalog:
+            offerings = [
+                Offering(o.zone, o.capacity_type, o.price,
+                         available=o.available and not self.unavailable.is_unavailable(
+                             o.capacity_type, it.name, o.zone))
+                for o in it.offerings
+            ]
+            if any(o.available for o in offerings):
+                out.append(InstanceType(
+                    name=it.name, requirements=it.requirements,
+                    offerings=offerings, capacity=it.capacity,
+                    kube_reserved=it.kube_reserved,
+                    system_reserved=it.system_reserved,
+                    eviction_threshold=it.eviction_threshold, info=it.info))
+        self._memo = (seq, out)
+        return out
+
+
+def _claim_compatible_types(claim: NodeClaim,
+                            instance_types: Sequence[InstanceType]) -> List[InstanceType]:
+    """Types whose requirements intersect the claim's and whose allocatable
+    covers the claim's aggregate requests
+    (/root/reference/pkg/cloudprovider/cloudprovider.go:255-266)."""
+    out = []
+    for it in instance_types:
+        # keys the type doesn't define (nodepool, user labels) are provided by
+        # the NodePool template at node creation — AllowUndefinedWellKnownLabels
+        # semantics (/root/reference/pkg/cloudprovider/cloudprovider.go:260-265)
+        allow = [k for k in claim.requirements if k not in it.requirements]
+        if not claim.requirements.compatible(it.requirements, allow_undefined=allow):
+            continue
+        if not claim.requests.fits(it.allocatable):
+            continue
+        if not any(o.available for o in it.offerings):
+            continue
+        out.append(it)
+    return out
+
+
+def _build_overrides(claim: NodeClaim, candidates: Sequence[InstanceType]) -> List[FleetOverride]:
+    """Cross-product (type × zone × capacity-type) filtered by claim
+    requirements, price-ordered, capped at MAX_INSTANCE_TYPES
+    (/root/reference/pkg/providers/instance/instance.go:327-367,395-412)."""
+    zone_req = claim.requirements.get(wk.ZONE)
+    cap_req = claim.requirements.get(wk.CAPACITY_TYPE)
+    # capacity-type choice: spot if allowed and available, else on-demand
+    # (instance.go:380-393)
+    allowed_caps = {wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND}
+    if cap_req is not None:
+        allowed_caps = {c for c in allowed_caps if cap_req.has(c)}
+    spot_available = any(
+        o.capacity_type == wk.CAPACITY_TYPE_SPOT and o.available
+        and (zone_req is None or zone_req.has(o.zone))
+        for it in candidates for o in it.offerings)
+    capacity_type = (wk.CAPACITY_TYPE_SPOT
+                     if wk.CAPACITY_TYPE_SPOT in allowed_caps and spot_available
+                     else wk.CAPACITY_TYPE_ON_DEMAND)
+    overrides = []
+    for it in candidates:
+        for o in it.offerings:
+            if not o.available or o.capacity_type != capacity_type:
+                continue
+            if zone_req is not None and not zone_req.has(o.zone):
+                continue
+            overrides.append(FleetOverride(it.name, o.zone, o.capacity_type, o.price))
+    overrides.sort(key=lambda ov: (ov.price, ov.instance_type, ov.zone))
+    # cap by distinct instance types, keeping all zones of kept types
+    kept_types: List[str] = []
+    out = []
+    for ov in overrides:
+        if ov.instance_type not in kept_types:
+            if len(kept_types) >= MAX_INSTANCE_TYPES:
+                continue
+            kept_types.append(ov.instance_type)
+        out.append(ov)
+    return out
+
+
+class CloudProvider:
+    """core CloudProvider implementation over the (fake) cloud substrate."""
+
+    name = "karpenter-tpu"
+
+    def __init__(self, cloud: FakeCloud, catalog: List[InstanceType],
+                 unavailable: Optional[UnavailableOfferings] = None,
+                 node_classes: Optional[Dict[str, NodeClass]] = None,
+                 cluster_name: str = "default",
+                 clock: Callable[[], float] = time.time):
+        self.cloud = cloud
+        self.unavailable = unavailable or UnavailableOfferings()
+        self.instance_types = InstanceTypesProvider(catalog, self.unavailable)
+        self.node_classes = node_classes or {"default": NodeClass()}
+        self.cluster_name = cluster_name
+        self.clock = clock
+        self._claims_by_provider_id: Dict[str, NodeClaim] = {}
+
+    # ---- catalog ----
+    def get_instance_types(self, nodepool: Optional[NodePool] = None) -> List[InstanceType]:
+        its = self.instance_types.list()
+        if nodepool is None:
+            return its
+        reqs = nodepool.requirements()
+        return [it for it in its
+                if reqs.compatible(it.requirements, allow_undefined=[wk.NODEPOOL])]
+
+    # ---- actuation ----
+    def create(self, claim: NodeClaim) -> NodeClaim:
+        """Launch capacity for a NodeClaim
+        (/root/reference/pkg/cloudprovider/cloudprovider.go:92-118 →
+        /root/reference/pkg/providers/instance/instance.go:88-105)."""
+        candidates = _claim_compatible_types(claim, self.instance_types.list())
+        if not candidates:
+            raise InsufficientCapacityError(
+                f"no compatible instance types for claim {claim.name}")
+        overrides = _build_overrides(claim, candidates)
+        if not overrides:
+            raise InsufficientCapacityError(
+                f"no available offerings for claim {claim.name}")
+        tags = {
+            "karpenter.sh/cluster": self.cluster_name,
+            "karpenter.sh/nodepool": claim.nodepool,
+            "karpenter.sh/nodeclaim": claim.name,
+            "Name": f"{claim.nodepool}/{claim.name}",
+        }
+        result = self.cloud.create_fleet(overrides, count=1, tags=tags)
+        # feed partial failures back into the ICE cache
+        # (instance.go:369-375 updateUnavailableOfferingsCache)
+        for err in result.errors:
+            if err.code == ICE_CODE:
+                self.unavailable.mark_unavailable_for_fleet_err(
+                    err.code, err.override.instance_type, err.override.zone,
+                    err.override.capacity_type)
+        if not result.instances:
+            raise InsufficientCapacityError(
+                f"all {len(overrides)} offerings ICE'd for claim {claim.name}")
+        inst = result.instances[0]
+        claim.provider_id = inst.id
+        claim.instance_type = inst.instance_type
+        claim.zone = inst.zone
+        claim.capacity_type = inst.capacity_type
+        claim.price = inst.price
+        claim.launched_at = inst.launched_at
+        claim.labels.update(self._instance_labels(inst, claim))
+        self._claims_by_provider_id[inst.id] = claim
+        return claim
+
+    def _instance_labels(self, inst, claim: NodeClaim) -> Dict[str, str]:
+        """instance → node labels
+        (instanceToNodeClaim, /root/reference/pkg/cloudprovider/cloudprovider.go:307-339)."""
+        labels = {
+            wk.INSTANCE_TYPE: inst.instance_type,
+            wk.ZONE: inst.zone,
+            wk.CAPACITY_TYPE: inst.capacity_type,
+            wk.NODEPOOL: claim.nodepool,
+        }
+        it = next((t for t in self.instance_types.base_catalog
+                   if t.name == inst.instance_type), None)
+        if it is not None:
+            labels.update({k: v for k, v in it.requirements.labels().items()
+                           if k not in (wk.ZONE, wk.CAPACITY_TYPE)})
+        return labels
+
+    def delete(self, claim: NodeClaim) -> None:
+        if not claim.provider_id:
+            return
+        done = self.cloud.terminate_instances([claim.provider_id])
+        claim.terminating = True
+        if not done:
+            raise CloudError("InstanceNotFound", claim.provider_id)
+
+    def get(self, provider_id: str) -> Optional[NodeClaim]:
+        try:
+            inst = self.cloud.get_instance(provider_id)
+        except CloudError:
+            return None
+        return self._instance_to_claim(inst)
+
+    def list(self) -> List[NodeClaim]:
+        """All cluster-owned instances as NodeClaims (GC ground truth,
+        /root/reference/pkg/controllers/nodeclaim/garbagecollection/controller.go:57-91)."""
+        out = []
+        for inst in self.cloud.describe_instances(
+                tag_filter={"karpenter.sh/cluster": self.cluster_name}):
+            out.append(self._instance_to_claim(inst))
+        return out
+
+    def _instance_to_claim(self, inst) -> NodeClaim:
+        known = self._claims_by_provider_id.get(inst.id)
+        if known is not None:
+            return known
+        claim = NodeClaim(nodepool=inst.tags.get("karpenter.sh/nodepool", ""))
+        claim.provider_id = inst.id
+        claim.instance_type = inst.instance_type
+        claim.zone = inst.zone
+        claim.capacity_type = inst.capacity_type
+        claim.price = inst.price
+        claim.launched_at = inst.launched_at
+        return claim
+
+    def is_drifted(self, claim: NodeClaim, nodepool: Optional[NodePool] = None) -> Optional[str]:
+        """Static drift detection analog
+        (/root/reference/pkg/cloudprovider/drift.go:42-67): the claim's
+        instance type must still exist in the catalog and satisfy the pool."""
+        it = next((t for t in self.instance_types.base_catalog
+                   if t.name == claim.instance_type), None)
+        if it is None:
+            return "InstanceTypeRemoved"
+        if nodepool is not None:
+            if not nodepool.requirements().compatible(
+                    it.requirements, allow_undefined=[wk.NODEPOOL]):
+                return "NodePoolDrifted"
+        nc = self.node_classes.get(claim.node_class_ref)
+        if nc is not None and nc.status_zones and claim.zone not in nc.status_zones:
+            return "ZoneDrifted"
+        return None
+
+    def liveness_probe(self) -> bool:
+        return True

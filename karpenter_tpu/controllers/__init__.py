@@ -1,0 +1,1 @@
+from .provisioning import Provisioner, ProvisioningResult, claim_from_decision

@@ -1,0 +1,189 @@
+"""Provisioning controller: pending pods → solver → NodeClaims → launches.
+
+The in-process equivalent of karpenter-core's provisioning controller
+(driven in reference tests via `provisioning.NewProvisioner`,
+/root/reference/pkg/cloudprovider/suite_test.go:87-88), re-architected
+around the batched TPU solve:
+
+  reference:  per-pod FFD loop over Go object graphs (designs/bin-packing.md)
+  here:       one tensorize() + one jit-compiled packing kernel per batch,
+              existing cluster capacity entering as pre-opened slots.
+
+Emits NodeClaims whose requirements carry the flexible instance-type/zone
+candidate lists, so the cloud layer can do CreateFleet-style flexible
+launches and ICE fallback (/root/reference/pkg/providers/instance/instance.go:88-105).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..api import labels as wk
+from ..api.objects import Node, NodeClaim, NodePool, Pod
+from ..api.requirements import IN, Requirement, Requirements
+from ..api.resources import PODS, ResourceList
+from ..cloud.provider import CloudProvider, InsufficientCapacityError
+from ..ops.ffd import NodeDecision, PackingResult, solve_ffd
+from ..ops.tensorize import Problem, tensorize
+from ..state.cluster import Cluster
+
+log = logging.getLogger("karpenter_tpu.provisioning")
+
+
+@dataclass
+class ProvisioningResult:
+    launched: List[NodeClaim] = field(default_factory=list)
+    bound_existing: int = 0
+    unschedulable: List[Pod] = field(default_factory=list)
+    failed_launches: List[str] = field(default_factory=list)
+    solve_seconds: float = 0.0
+
+    bound_new: int = 0
+
+    @property
+    def scheduled(self) -> int:
+        return self.bound_existing + self.bound_new
+
+
+def claim_from_decision(decision: NodeDecision, pods: Sequence[Pod],
+                        pools: Dict[str, NodePool]) -> NodeClaim:
+    """NodeDecision → NodeClaim with flexible candidates encoded as
+    requirements (the shape CloudProvider.Create consumes,
+    /root/reference/pkg/cloudprovider/cloudprovider.go:92-118)."""
+    opt = decision.option
+    pool = pools[opt.pool]
+    alt_types = [a.instance_type for a in decision.alternatives] or [opt.instance_type]
+    alt_zones = sorted({a.zone for a in decision.alternatives} | {opt.zone})
+    requests = ResourceList()
+    for p in pods:
+        requests = requests + p.requests
+    requests[PODS] = requests.get(PODS, 0) + len(pods)
+    claim = NodeClaim(
+        nodepool=opt.pool,
+        # pool requirements ∩ the decision's flexible candidate lists — a
+        # claim always satisfies its NodePool's constraints
+        requirements=pool.requirements().union(Requirements.of(
+            Requirement(wk.INSTANCE_TYPE, IN, alt_types),
+            Requirement(wk.ZONE, IN, alt_zones),
+            Requirement(wk.CAPACITY_TYPE, IN, [opt.capacity_type]),
+            Requirement(wk.NODEPOOL, IN, [opt.pool]),
+        )),
+        requests=requests,
+        taints=list(pool.template.taints) + list(pool.template.startup_taints),
+        node_class_ref=pool.template.node_class_ref,
+        labels=dict(pool.template.labels),
+    )
+    claim._decision_pods = list(pods)  # transient: bound after registration
+    return claim
+
+
+class Provisioner:
+    """Batch scheduling loop (pod batching windows live in the controller
+    runtime; this is the per-batch solve)."""
+
+    def __init__(self, provider: CloudProvider, cluster: Cluster,
+                 nodepools: Sequence[NodePool],
+                 clock: Callable[[], float] = time.time,
+                 max_nodes_per_round: int = 2048):
+        self.provider = provider
+        self.cluster = cluster
+        self.nodepools = {p.name: p for p in nodepools}
+        self.clock = clock
+        self.max_nodes_per_round = max_nodes_per_round
+
+    def _pools_within_limits(self) -> List[NodePool]:
+        usage = self.cluster.nodepool_usage()
+        out = []
+        for pool in self.nodepools.values():
+            if pool.within_limits(usage.get(pool.name, ResourceList())):
+                out.append(pool)
+            else:
+                log.info("nodepool %s at limit, excluded from provisioning", pool.name)
+        return out
+
+    def solve(self, pods: Sequence[Pod],
+              schedule_on_existing: bool = True) -> tuple:
+        """Tensorize + pack one batch. Returns (problem, PackingResult)."""
+        pools = self._pools_within_limits()  # weight precedence is encoded in
+        catalog = self.provider.get_instance_types()  # LaunchOption.weight_rank
+        problem = tensorize(pods, catalog, pools)
+        if schedule_on_existing and self.cluster.nodes:
+            node_list, alloc, used, compat = self.cluster.tensorize_nodes(
+                problem.class_reps, problem.axes)
+            result = solve_ffd(problem, max_nodes=self.max_nodes_per_round,
+                               existing_alloc=alloc, existing_used=used,
+                               existing_compat=compat)
+            result._existing_nodes = node_list
+        else:
+            result = solve_ffd(problem, max_nodes=self.max_nodes_per_round)
+            result._existing_nodes = []
+        return problem, result
+
+    def provision(self, pods: Optional[Sequence[Pod]] = None,
+                  max_retries: int = 1) -> ProvisioningResult:
+        """One provisioning round: solve the batch, launch, register, bind.
+
+        If launches fail on exhausted capacity, the round re-solves once
+        against the now-ICE-masked catalog (the reference reaches the same
+        fixpoint via its retry-on-next-reconcile plus the launch-path retry
+        at /root/reference/pkg/providers/instance/instance.go:96-100)."""
+        out = self._provision_once(pods)
+        retries = 0
+        while out.failed_launches and out.unschedulable and retries < max_retries:
+            retries += 1
+            retry = self._provision_once([p for p in out.unschedulable
+                                          if not p.node_name])
+            out.launched.extend(retry.launched)
+            out.bound_existing += retry.bound_existing
+            out.bound_new += retry.bound_new
+            out.unschedulable = retry.unschedulable
+            out.failed_launches.extend(retry.failed_launches)
+        return out
+
+    def _provision_once(self, pods: Optional[Sequence[Pod]] = None) -> ProvisioningResult:
+        t0 = self.clock()
+        out = ProvisioningResult()
+        if pods is None:
+            pods = self.cluster.pending_pods()
+        if not pods:
+            return out
+        if not self.nodepools:
+            out.unschedulable = list(pods)
+            return out
+        problem, packing = self.solve(pods)
+        out.solve_seconds = self.clock() - t0
+        catalog_by_name = {it.name: it for it in self.provider.get_instance_types()}
+
+        # pods placed on existing nodes
+        for pod_i, slot in packing.existing_assignments.items():
+            node = packing._existing_nodes[slot]
+            self.cluster.bind_pod(problem.pods[pod_i], node.name)
+            out.bound_existing += 1
+
+        # new nodes
+        for decision in packing.nodes:
+            dpods = [problem.pods[i] for i in decision.pod_indices]
+            claim = claim_from_decision(decision, dpods, self.nodepools)
+            try:
+                claim = self.provider.create(claim)
+            except InsufficientCapacityError as e:
+                # leave pods pending; ICE cache updated inside create() so the
+                # next round solves against a corrected catalog
+                log.warning("launch failed: %s", e)
+                out.failed_launches.append(str(e))
+                out.unschedulable.extend(dpods)
+                continue
+            it = catalog_by_name.get(claim.instance_type)
+            allocatable = it.allocatable if it else claim.requests
+            node = self.cluster.register_nodeclaim(claim, allocatable,
+                                                   it.capacity if it else None)
+            for p in dpods:
+                self.cluster.bind_pod(p, node.name)
+            out.bound_new += len(dpods)
+            out.launched.append(claim)
+
+        out.unschedulable.extend(problem.pods[i] for i in packing.unschedulable)
+        return out

@@ -1,0 +1,3 @@
+from .tensorize import LaunchOption, Problem, build_options, tensorize, pad_to
+from .ffd import NodeDecision, PackingResult, ffd_pack_kernel, solve_ffd, NO_ASSIGNMENT
+from .classpack import class_pack_kernel, solve_classpack

@@ -1,0 +1,237 @@
+"""Class-granular packing: one scan step per pod *equivalence class*.
+
+The key TPU-first re-design of the reference's FFD loop
+(/root/reference/designs/bin-packing.md:16-43): identical pods are
+interchangeable, so a batch of 50k pods usually collapses to a few hundred
+classes (the reference batches "similar pods" the same way, just one pod at a
+time).  Each scan step places an entire class:
+
+  * existing/open slots absorb `min(count, floor(free/req))` pods each —
+    a K-vector computation with an exclusive-cumsum greedy fill that is
+    exactly first-fit for identical pods;
+  * overflow opens `ceil(rem/m)` new nodes of the option minimizing
+    price-per-pod (the reference's "instance type that maximizes additional
+    pods packed" heuristic, re-expressed as a cost score).
+
+All arithmetic is int32 in scaled units (millicores / MiB / counts) so
+feasibility math is exact — no float rounding can overfill a node.
+Complexity: O(C × (K + O) × R) data-parallel work instead of the reference's
+O(P × nodes × types) pointer-chasing loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.resources import DEFAULT_SCALES, ResourceList
+from .ffd import NodeDecision, PackingResult
+from .tensorize import LaunchOption, Problem, pad_to
+
+_BIG = np.int32(2**30)
+
+
+@partial(jax.jit, static_argnames=("max_nodes", "emit_takes"))
+def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
+                      counts: jax.Array,     # C int32
+                      compat: jax.Array,     # C×(O+E) bool
+                      alloc: jax.Array,      # (O+E)×R int32
+                      price: jax.Array,      # (O+E) f32; +inf == not launchable
+                      rank: jax.Array,       # (O+E) int32 pool-weight rank
+                      init_option: jax.Array,  # K int32, -1 closed
+                      init_used: jax.Array,    # K×R int32
+                      max_nodes: int,
+                      emit_takes: bool = False):
+    K = max_nodes
+    idx = jnp.arange(K)
+
+    def step(carry, x):
+        slot_option, slot_used, n_open, n_unsched = carry
+        req, cnt, comp = x
+        opt = jnp.maximum(slot_option, 0)
+        open_mask = slot_option >= 0
+        free = alloc[opt] - slot_used                       # K×R
+        reqpos = req > 0
+        safe_req = jnp.where(reqpos, req, 1)
+        fit = jnp.min(jnp.where(reqpos[None, :], free // safe_req[None, :], _BIG),
+                      axis=-1)                              # pods each slot absorbs
+        fit = jnp.where(open_mask & comp[opt], jnp.maximum(fit, 0), 0)
+        prefix = jnp.cumsum(fit) - fit                      # exclusive cumsum
+        take = jnp.clip(cnt - prefix, 0, fit)               # greedy first-fit fill
+        remaining = cnt - jnp.sum(take)
+
+        # new nodes: option minimizing TOTAL cost to absorb the class tail,
+        # price × ceil(remaining/m) — the tail-aware version of the
+        # reference's "maximize additional pods packed" tie-break
+        m = jnp.min(jnp.where(reqpos[None, :], alloc // safe_req[None, :], _BIG),
+                    axis=-1)                                # pods per fresh node
+        ok = comp & (m > 0) & jnp.isfinite(price)
+        # pool precedence: restrict to the best (lowest) weight-rank available
+        best_rank = jnp.min(jnp.where(ok, rank, _BIG))
+        ok = ok & (rank == best_rank)
+        m_safe = jnp.maximum(m, 1)
+        nodes_needed = (jnp.maximum(remaining, 1) + m_safe - 1) // m_safe
+        score = jnp.where(ok, price * nodes_needed.astype(price.dtype), jnp.inf)
+        j = jnp.argmin(score)                               # ties → cheapest (pre-sorted)
+        can = jnp.isfinite(score[j])
+        m_sel = jnp.maximum(m[j], 1)
+        needed = jnp.where(can & (remaining > 0),
+                           (remaining + m_sel - 1) // m_sel, 0)
+        n_new = jnp.minimum(needed, K - n_open)
+        sched_new = jnp.minimum(remaining, n_new * m_sel)
+        is_new = (idx >= n_open) & (idx < n_open + n_new)
+        pods_on = jnp.where(is_new, m_sel, 0)
+        rem_last = sched_new - (n_new - 1) * m_sel          # last node partial
+        pods_on = jnp.where(is_new & (idx == n_open + n_new - 1), rem_last, pods_on)
+        slot_option = jnp.where(is_new, j.astype(slot_option.dtype), slot_option)
+        placed = take + pods_on
+        slot_used = slot_used + placed[:, None] * req[None, :]
+        n_open = n_open + n_new
+        n_unsched = n_unsched + (remaining - sched_new)
+        carry = (slot_option, slot_used, n_open, n_unsched)
+        return carry, (placed if emit_takes else jnp.sum(take))
+
+    C = requests.shape[0]
+    n_open0 = jnp.sum(init_option >= 0).astype(jnp.int32)
+    (slot_option, slot_used, n_open, n_unsched), takes = jax.lax.scan(
+        step, (init_option, init_used, n_open0, jnp.int32(0)),
+        (requests, counts, compat))
+    return slot_option, slot_used, n_open, n_unsched, takes
+
+
+def _sorted_classes(problem: Problem, extra_compat: Optional[np.ndarray]):
+    """FFD order over classes via Problem.class_order() — the shared key, so
+    class-granular and pod-granular solves agree on ordering."""
+    order = problem.class_order()
+    compat = problem.class_compat[order]
+    if extra_compat is not None:
+        compat = np.concatenate([compat, extra_compat[order]], axis=1)
+    return (problem.class_requests[order], problem.class_counts[order],
+            compat, order)
+
+
+def solve_classpack(problem: Problem,
+                    max_nodes: int = 8192,
+                    existing_alloc: Optional[np.ndarray] = None,
+                    existing_used: Optional[np.ndarray] = None,
+                    existing_compat: Optional[np.ndarray] = None,
+                    decode: bool = True,
+                    max_alternatives: int = 60) -> PackingResult:
+    """Host wrapper: sort classes → pad → kernel → decode.
+
+    With decode=False only aggregate state is materialized (bench path:
+    node count + total price, no per-pod binding)."""
+    E = 0 if existing_alloc is None else len(existing_alloc)
+    ec = None
+    if E:
+        ec = existing_compat if existing_compat is not None else \
+            np.ones((problem.num_classes, E), bool)
+    requests, counts, compat, order = _sorted_classes(problem, ec)
+    C, R = requests.shape
+    alloc = problem.option_alloc
+    price = problem.option_price.astype(np.float32)
+    O = alloc.shape[0]
+    if E:
+        alloc = np.concatenate([alloc, existing_alloc.astype(np.float32)], axis=0)
+        price = np.concatenate([price, np.full(E, np.inf, np.float32)])
+
+    if alloc.shape[0] == 0:  # no options and no existing nodes
+        return PackingResult(
+            nodes=[], unschedulable=[p for m in problem.class_members for p in m],
+            existing_assignments={}, total_price=0.0)
+    rank = np.zeros(alloc.shape[0], np.int32)
+    rank[:O] = problem.option_rank
+
+    # pad class axis AND option axis so catalog/ICE/cluster deltas reuse
+    # compiled programs
+    Cpad = pad_to(C, (64, 256, 1024, 4096))
+    Opad = pad_to(alloc.shape[0], (512, 2048, 8192, 32768))
+    req_p = np.zeros((Cpad, R), np.int32)
+    req_p[:C] = requests.astype(np.int32)
+    cnt_p = np.zeros(Cpad, np.int32)
+    cnt_p[:C] = counts
+    comp_p = np.zeros((Cpad, Opad), bool)
+    comp_p[:C, :alloc.shape[0]] = compat
+    alloc_p = np.zeros((Opad, R), np.float32)
+    alloc_p[:alloc.shape[0]] = alloc
+    price_p = np.full(Opad, np.inf, np.float32)
+    price_p[:alloc.shape[0]] = price
+    rank_p = np.full(Opad, 2**30 - 1, np.int32)
+    rank_p[:alloc.shape[0]] = rank
+    alloc, price, rank = alloc_p, price_p, rank_p
+
+    # slot count: never more nodes than pods; bucketed for compile reuse
+    P = int(problem.class_counts.sum())
+    K = max(min(max_nodes, pad_to(P + E, (256, 1024, 8192))), E + 1)
+    init_option = np.full(K, -1, np.int32)
+    init_used = np.zeros((K, R), np.int32)
+    if E:
+        init_option[:E] = np.arange(O, O + E, dtype=np.int32)
+        if existing_used is not None:
+            init_used[:E] = np.ceil(existing_used).astype(np.int32)
+
+    slot_option, slot_used, n_open, n_unsched, takes = class_pack_kernel(
+        jnp.asarray(req_p), jnp.asarray(cnt_p), jnp.asarray(comp_p),
+        jnp.asarray(alloc.astype(np.int32)), jnp.asarray(price),
+        jnp.asarray(rank),
+        jnp.asarray(init_option), jnp.asarray(init_used),
+        K, decode)
+    slot_option = np.asarray(slot_option)
+    slot_used = np.asarray(slot_used)
+    n_open = int(n_open)
+
+    new_mask = (slot_option >= 0) & (slot_option < O)
+    total = float(problem.option_price[slot_option[new_mask]].sum())
+
+    if not decode:
+        nodes = [NodeDecision(option=problem.options[int(o)], pod_indices=[])
+                 for o in slot_option[new_mask]]
+        return PackingResult(nodes=nodes, unschedulable=[None] * int(n_unsched),
+                             existing_assignments={}, total_price=total)
+
+    takes = np.asarray(takes)[:C]                      # C×K placement counts
+    # walk classes in solve order, consuming member pod indices in sequence
+    slot_pods: Dict[int, List[int]] = {}
+    slot_classes: Dict[int, List[int]] = {}
+    existing_assignments: Dict[int, int] = {}
+    unschedulable: List[int] = []
+    for row, ci in enumerate(order):
+        members = problem.class_members[ci]
+        pos = 0
+        for k in np.nonzero(takes[row])[0]:
+            n = int(takes[row, k])
+            chunk, pos = members[pos:pos + n], pos + n
+            if int(k) < E:
+                for p in chunk:
+                    existing_assignments[p] = int(k)
+            else:
+                slot_pods.setdefault(int(k), []).extend(chunk)
+                slot_classes.setdefault(int(k), []).append(int(ci))
+        unschedulable.extend(members[pos:])
+
+    nodes = []
+    for k in sorted(slot_pods):
+        oi = int(slot_option[k])
+        if not (0 <= oi < O):
+            continue
+        # flexible alternatives: jointly compatible with every class on the
+        # node, big enough for its total usage, and from the same pool
+        jc = problem.class_compat[slot_classes[k]].all(axis=0)
+        cap_ok = (problem.option_alloc >= slot_used[k]).all(axis=1)
+        opt_obj = problem.options[oi]
+        same_pool = np.asarray([o.pool == opt_obj.pool for o in problem.options])
+        alt_ids = np.nonzero(jc & cap_ok & same_pool)[0][:max_alternatives]
+        nodes.append(NodeDecision(
+            option=problem.options[oi],
+            pod_indices=slot_pods[k],
+            used=ResourceList.from_vector(slot_used[k], problem.axes, DEFAULT_SCALES),
+            alternatives=[problem.options[a] for a in alt_ids],
+        ))
+    return PackingResult(nodes=nodes, unschedulable=unschedulable,
+                         existing_assignments=existing_assignments,
+                         total_price=total)

@@ -1,0 +1,220 @@
+"""First-fit-decreasing packing as a jit-compiled lax.scan.
+
+TPU re-expression of the reference scheduler's greedy loop
+(/root/reference/designs/bin-packing.md:16-43: sort pods by resources
+descending, place each on an existing node else open the best new node).
+Instead of Go's per-pod × per-node × per-type nested loops, each scan step
+evaluates feasibility against *all* open node slots and *all* launch options
+as dense vector ops (VPU-friendly K×R / O×R comparisons), with
+data-independent control flow (`jnp.where` masks, no branches) so XLA
+compiles one fixed program.
+
+The same kernel doubles as the consolidation simulator: pre-opened slots
+(`init_option`/`init_used`) represent existing cluster nodes, so "would these
+pods fit on the remaining nodes [+ one cheaper node]" is just a call with
+different initial state (SURVEY.md §7.6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.resources import DEFAULT_SCALES, ResourceList
+from .tensorize import LaunchOption, Problem, pad_to
+
+NO_ASSIGNMENT = -1
+
+
+@partial(jax.jit, static_argnames=("max_nodes",))
+def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
+                    compat: jax.Array,      # P×O bool
+                    valid: jax.Array,       # P bool (padding mask)
+                    alloc: jax.Array,       # O×R full-capacity allocatable
+                    price: jax.Array,       # O
+                    rank: jax.Array,        # O int32 pool-weight rank
+                    init_option: jax.Array, # K int32, -1 == closed slot
+                    init_used: jax.Array,   # K×R resources already used
+                    max_nodes: int):
+    """Returns (assignment P int32 slot-or--1, slot_option K, slot_used K×R,
+    n_open)."""
+    K = max_nodes
+    _IBIG = jnp.int32(2**30)
+
+    def step(carry, x):
+        slot_option, slot_used, n_open = carry
+        req, comp, is_valid = x
+        opt = jnp.maximum(slot_option, 0)
+        open_mask = slot_option >= 0
+        slot_alloc = alloc[opt]                                   # K×R gather
+        fits = open_mask & comp[opt] & jnp.all(slot_used + req <= slot_alloc, axis=-1)
+        exist_k = jnp.argmax(fits)            # first-fit: lowest feasible slot
+        any_fit = jnp.any(fits)
+        # new node: highest-weight pool first (NodePool.spec.weight
+        # precedence), then cheapest able to hold the pod at full capacity;
+        # options are price-sorted with deterministic tie-breaks
+        # (instance.go:395-412), so argmin's first-match rule preserves them.
+        new_ok = comp & jnp.all(req <= alloc, axis=-1) & jnp.isfinite(price)
+        best_rank = jnp.min(jnp.where(new_ok, rank, _IBIG))
+        new_ok_r = new_ok & (rank == best_rank)
+        new_opt = jnp.argmin(jnp.where(new_ok_r, price, jnp.inf))
+        can_new = jnp.any(new_ok) & (n_open < K)
+        sched_exist = is_valid & any_fit
+        sched_new = is_valid & ~any_fit & can_new
+        placed = sched_exist | sched_new
+        k = jnp.where(sched_exist, exist_k, n_open)
+        k_safe = jnp.clip(k, 0, K - 1)
+        slot_used = slot_used.at[k_safe].add(jnp.where(placed, req, 0.0))
+        slot_option = slot_option.at[k_safe].set(
+            jnp.where(sched_new, new_opt, slot_option[k_safe]))
+        n_open = n_open + sched_new.astype(jnp.int32)
+        return (slot_option, slot_used, n_open), jnp.where(placed, k_safe, NO_ASSIGNMENT)
+
+    n_open0 = jnp.sum(init_option >= 0).astype(jnp.int32)
+    (slot_option, slot_used, n_open), assignment = jax.lax.scan(
+        step, (init_option, init_used, n_open0), (requests, compat, valid))
+    return assignment, slot_option, slot_used, n_open
+
+
+@dataclass
+class NodeDecision:
+    """One node to launch: the chosen option plus the pods packed onto it.
+    The flexible `alternatives` list (instance types the packed pods are
+    jointly compatible with, price-ordered) is what feeds CreateFleet-style
+    flexible launches (/root/reference/pkg/providers/instance/instance.go:88-105)."""
+    option: LaunchOption
+    pod_indices: List[int]
+    used: "ResourceList" = None   # canonical units (bytes/millicores)
+    alternatives: List[LaunchOption] = field(default_factory=list)
+
+
+@dataclass
+class PackingResult:
+    nodes: List[NodeDecision]
+    unschedulable: List[int]            # original pod indices
+    existing_assignments: Dict[int, int]  # pod index -> pre-opened slot id
+    total_price: float
+
+    @property
+    def scheduled_count(self) -> int:
+        return (sum(len(n.pod_indices) for n in self.nodes)
+                + len(self.existing_assignments))
+
+
+def solve_ffd(problem: Problem,
+              max_nodes: Optional[int] = None,
+              existing_alloc: Optional[np.ndarray] = None,   # E×R
+              existing_used: Optional[np.ndarray] = None,    # E×R
+              existing_compat: Optional[np.ndarray] = None,  # C×E bool
+              max_alternatives: int = 60) -> PackingResult:
+    """Host wrapper: expand classes → pad → run kernel → decode decisions.
+
+    Existing cluster nodes (for provisioning against live capacity and for
+    consolidation simulation) enter as pre-opened slots with price already
+    paid: their allocatable/used vectors are appended as zero-price virtual
+    options.
+    """
+    E = 0 if existing_alloc is None else len(existing_alloc)
+    ec = None
+    if E:
+        ec = existing_compat if existing_compat is not None else \
+            np.ones((problem.num_classes, E), bool)
+    requests, compat, pod_idx = problem.expand(extra_compat=ec)
+    P = len(requests)
+    alloc = problem.option_alloc
+    price = problem.option_price
+    O = alloc.shape[0]
+    R = alloc.shape[1]
+    if E:
+        # one virtual option per existing node, price 0 (sunk cost)
+        alloc = np.concatenate([alloc, existing_alloc.astype(np.float32)], axis=0)
+        price = np.concatenate([price, np.zeros(E, np.float32)])
+    if alloc.shape[0] == 0:  # no options and no existing nodes
+        return PackingResult(nodes=[], unschedulable=[int(i) for i in pod_idx],
+                             existing_assignments={}, total_price=0.0)
+    K = max_nodes if max_nodes is not None else 4096
+    K = min(K, pad_to(P + E, (256, 1024, 4096)))
+    K = max(K, E + 1)
+
+    rank = np.zeros(alloc.shape[0], np.int32)
+    rank[:O] = problem.option_rank
+    new_price = price.copy()
+    if E:
+        new_price[O:] = np.inf  # existing nodes can't be "launched" again
+
+    # pad both the pod axis and the option axis (columns) so catalog/ICE/
+    # cluster-size changes reuse compiled programs instead of recompiling
+    Ppad = pad_to(P)
+    Opad = pad_to(alloc.shape[0], (512, 2048, 8192, 32768))
+    req_p = np.zeros((Ppad, R), np.float32)
+    req_p[:P] = requests
+    comp_p = np.zeros((Ppad, Opad), bool)
+    comp_p[:P, :alloc.shape[0]] = compat
+    valid = np.zeros(Ppad, bool)
+    valid[:P] = True
+    alloc_p = np.zeros((Opad, R), np.float32)
+    alloc_p[:alloc.shape[0]] = alloc
+    price_p = np.full(Opad, np.inf, np.float32)
+    price_p[:alloc.shape[0]] = new_price
+    rank_p = np.full(Opad, 2**30, np.int32)
+    rank_p[:alloc.shape[0]] = rank
+
+    init_option = np.full(K, -1, np.int32)
+    init_used = np.zeros((K, R), np.float32)
+    if E:
+        init_option[:E] = np.arange(O, O + E, dtype=np.int32)
+        init_used[:E] = existing_used.astype(np.float32) if existing_used is not None else 0.0
+
+    assignment, slot_option, slot_used, n_open = ffd_pack_kernel(
+        jnp.asarray(req_p), jnp.asarray(comp_p), jnp.asarray(valid),
+        jnp.asarray(alloc_p), jnp.asarray(price_p), jnp.asarray(rank_p),
+        jnp.asarray(init_option), jnp.asarray(init_used), K)
+    assignment = np.asarray(assignment)[:P]
+    slot_option = np.asarray(slot_option)
+    slot_used = np.asarray(slot_used)
+
+    # decode
+    slot_pods: Dict[int, List[int]] = {}
+    slot_rows: Dict[int, List[int]] = {}
+    unschedulable: List[int] = []
+    existing_assignments: Dict[int, int] = {}
+    for row, k in enumerate(assignment):
+        orig = int(pod_idx[row])
+        if k == NO_ASSIGNMENT:
+            unschedulable.append(orig)
+        elif k < E:
+            existing_assignments[orig] = int(k)
+        else:
+            slot_pods.setdefault(int(k), []).append(orig)
+            slot_rows.setdefault(int(k), []).append(row)
+
+    nodes: List[NodeDecision] = []
+    total = 0.0
+    for k, pods_on_node in sorted(slot_pods.items()):
+        oi = int(slot_option[k])
+        if oi < 0 or oi >= O:
+            continue
+        option = problem.options[oi]
+        total += option.price
+        # joint-compat alternatives for flexible launch — same pool only
+        # (a NodeClaim belongs to exactly one NodePool)
+        rows = slot_rows.get(k, [])
+        joint = compat[rows][:, :O].all(axis=0) if rows else np.zeros(O, bool)
+        used_vec = slot_used[k]
+        cap_ok = (problem.option_alloc >= used_vec).all(axis=1)
+        same_pool = np.asarray([o.pool == option.pool for o in problem.options])
+        alt_ids = np.nonzero(joint & cap_ok & same_pool)[0][:max_alternatives]
+        nodes.append(NodeDecision(
+            option=option,
+            pod_indices=pods_on_node,
+            used=ResourceList.from_vector(used_vec, problem.axes, DEFAULT_SCALES),
+            alternatives=[problem.options[a] for a in alt_ids],
+        ))
+    return PackingResult(nodes=nodes, unschedulable=unschedulable,
+                         existing_assignments=existing_assignments,
+                         total_price=total)

@@ -1,0 +1,263 @@
+"""Tensorization: pods + catalog + nodepools → dense arrays.
+
+This layer replaces the reference's per-pod set algebra
+(`scheduling.Requirements.Compatible` at
+/root/reference/pkg/cloudprovider/cloudprovider.go:260-265 and the
+per-(pod,instance-type) inner loop of the FFD scheduler described in
+/root/reference/designs/bin-packing.md:16-43) with a one-shot lowering:
+
+  * pods are deduplicated into **equivalence classes** (identical requests +
+    constraints) — the host does set algebra once per (class × launch option)
+    instead of once per (pod × node × type) inside the scheduling loop;
+  * the catalog is flattened into **launch options** — one column per
+    (nodepool × instance-type × zone × capacity-type) available offering,
+    the exact action space of the reference's CreateFleet override list
+    (/root/reference/pkg/providers/instance/instance.go:327-367);
+  * the result is a `Problem` of dense arrays (requests C×R / P×R, compat
+    C×O / P×O, allocatable O×R, price O) that the jit-compiled kernels in
+    karpenter_tpu.ops.{ffd,sinkhorn} consume with static shapes.
+
+Shape discipline: `pad_to` buckets P and O up to fixed sizes so recompiles
+are bounded (SURVEY.md §7 hard part iv).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as wk
+from ..api.objects import Node, NodePool, Pod
+from ..api.requirements import IN, Requirement, Requirements
+from ..api.resources import DEFAULT_AXES, DEFAULT_SCALES, PODS, ResourceList
+from ..api.taints import tolerates_all
+from ..catalog.instancetype import InstanceType, Offering
+
+
+@dataclass(frozen=True)
+class LaunchOption:
+    """One solver column: a concrete way to buy a node."""
+    pool: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: float
+    type_index: int       # into the catalog list
+    pool_index: int
+    weight_rank: int = 0  # 0 == highest-weight pool (pool precedence)
+
+
+@dataclass
+class Problem:
+    """Dense scheduling problem. All arrays are numpy on the host; kernels
+    move them to device once per solve."""
+    axes: Tuple[str, ...]
+    # per pod-class
+    class_requests: np.ndarray      # C×R float32
+    class_counts: np.ndarray        # C int32
+    class_compat: np.ndarray        # C×O bool
+    class_members: List[List[int]]  # class -> original pod indices
+    # per launch option (column)
+    options: List[LaunchOption]
+    option_alloc: np.ndarray        # O×R float32
+    option_price: np.ndarray        # O float32
+    option_rank: np.ndarray = None  # O int32 pool-weight rank (0 = preferred)
+    option_zone: np.ndarray = None  # O int32
+    option_captype: np.ndarray = None  # O int32 (0=on-demand, 1=spot)
+    zones: List[str] = field(default_factory=list)
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def num_classes(self) -> int:
+        return self.class_requests.shape[0]
+
+    @property
+    def class_reps(self) -> List[Pod]:
+        """One representative pod per equivalence class."""
+        return [self.pods[m[0]] for m in self.class_members]
+
+    def class_order(self) -> np.ndarray:
+        """FFD order over classes (largest first) under a scale-free size key
+        (per-axis mean allocatable). The single source of ordering truth for
+        expand(), the class-granular solver, and the test oracles."""
+        norm = (self.option_alloc.mean(axis=0) if self.num_options
+                else np.ones(len(self.axes), np.float32))
+        norm = np.where(norm > 0, norm, 1.0)
+        size = (self.class_requests / norm).sum(axis=1)
+        return np.argsort(-size, kind="stable")
+
+    @property
+    def num_options(self) -> int:
+        return self.option_alloc.shape[0]
+
+    # ---- per-pod expansion (for pod-granular kernels) ----
+    def expand(self, sort_desc: bool = True, extra_compat: Optional[np.ndarray] = None):
+        """Expand classes to per-pod rows, FFD-sorted (largest first, as the
+        reference sorts pods by resources descending,
+        /root/reference/designs/bin-packing.md:16-20). Returns
+        (requests P×R, compat P×(O[+E]), pod_index P). `extra_compat` (C×E,
+        e.g. per-existing-node feasibility) is expanded and appended as extra
+        columns in the same row order."""
+        class_ids = np.repeat(np.arange(self.num_classes), self.class_counts)
+        requests = self.class_requests[class_ids]
+        compat = self.class_compat[class_ids]
+        if extra_compat is not None:
+            compat = np.concatenate([compat, extra_compat[class_ids]], axis=1)
+        pod_idx = np.concatenate([np.asarray(m, dtype=np.int32) for m in self.class_members]) \
+            if self.class_members else np.zeros(0, np.int32)
+        if sort_desc and len(requests):
+            class_rank = np.empty(self.num_classes, np.int64)
+            class_rank[self.class_order()] = np.arange(self.num_classes)
+            order = np.argsort(class_rank[class_ids], kind="stable")
+            requests, compat, pod_idx = requests[order], compat[order], pod_idx[order]
+        return requests.astype(np.float32), compat, pod_idx
+
+
+def _class_key(pod: Pod) -> tuple:
+    return (
+        tuple(sorted(pod.requests.nonzero().items())),
+        tuple(sorted(pod.node_selector.items())),
+        tuple(repr(t) for t in pod.required_affinity_terms),
+        tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
+        tuple((c.topology_key, c.max_skew, c.when_unsatisfiable,
+               tuple(sorted(c.label_selector.items()))) for c in pod.topology_spread),
+        tuple((a.topology_key, a.anti, a.required,
+               tuple(sorted(a.label_selector.items()))) for a in pod.pod_affinities),
+        tuple(sorted(pod.labels.items())),
+    )
+
+
+def build_options(catalog: Sequence[InstanceType],
+                  nodepools: Sequence[NodePool]) -> List[LaunchOption]:
+    """Flatten (nodepool × type × zone × capacity-type) available offerings,
+    dropping options the nodepool's own requirements exclude.  Higher-weight
+    NodePools rank first (weight precedence, reference NodePool.spec.weight)."""
+    ranks = {w: i for i, w in
+             enumerate(sorted({p.weight for p in nodepools}, reverse=True))}
+    out: List[LaunchOption] = []
+    for pi, pool in enumerate(nodepools):
+        pool_reqs = pool.requirements()
+        for ti, it in enumerate(catalog):
+            # keys the type doesn't define (nodepool, template labels) are
+            # provided by the pool itself at node creation — only type-defined
+            # keys can conflict (AllowUndefinedWellKnownLabels semantics)
+            allow = [k for k in pool_reqs if k not in it.requirements]
+            if not pool_reqs.compatible(it.requirements, allow_undefined=allow):
+                continue
+            zone_req = pool_reqs.get(wk.ZONE)
+            cap_req = pool_reqs.get(wk.CAPACITY_TYPE)
+            for o in it.offerings:
+                if not o.available:
+                    continue
+                if zone_req is not None and not zone_req.has(o.zone):
+                    continue
+                if cap_req is not None and not cap_req.has(o.capacity_type):
+                    continue
+                out.append(LaunchOption(pool.name, it.name, o.zone,
+                                        o.capacity_type, o.price, ti, pi,
+                                        weight_rank=ranks[pool.weight]))
+    # pool precedence first, then deterministic price ordering with name
+    # tie-break (/root/reference/pkg/providers/instance/instance.go:395-412)
+    out.sort(key=lambda lo: (lo.weight_rank, lo.price, lo.instance_type,
+                             lo.zone, lo.capacity_type, lo.pool))
+    return out
+
+
+def _option_requirements(option: LaunchOption, it: InstanceType,
+                         pool: NodePool) -> Requirements:
+    """The label surface a node launched from this option will have."""
+    reqs = Requirements(it.requirements)
+    reqs = reqs.union(Requirements.of(
+        Requirement(wk.ZONE, IN, [option.zone]),
+        Requirement(wk.CAPACITY_TYPE, IN, [option.capacity_type]),
+        Requirement(wk.NODEPOOL, IN, [option.pool]),
+    ))
+    return reqs.union(Requirements.from_labels(pool.template.labels))
+
+
+def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
+              nodepools: Sequence[NodePool],
+              axes: Tuple[str, ...] = DEFAULT_AXES) -> Problem:
+    """Lower a scheduling round to dense arrays."""
+    pools = {p.name: p for p in nodepools}
+    options = build_options(catalog, nodepools)
+    O, R = len(options), len(axes)
+
+    option_alloc = np.zeros((O, R), np.float32)
+    option_price = np.zeros(O, np.float32)
+    zones = sorted({o.zone for o in options})
+    zone_ids = {z: i for i, z in enumerate(zones)}
+    option_zone = np.zeros(O, np.int32)
+    option_captype = np.zeros(O, np.int32)
+    option_rank = np.zeros(O, np.int32)
+    option_reqs: List[Requirements] = []
+    option_taints = []
+    for j, opt in enumerate(options):
+        option_rank[j] = opt.weight_rank
+        it = catalog[opt.type_index]
+        pool = pools[opt.pool]
+        option_alloc[j] = it.allocatable.to_vector(axes, DEFAULT_SCALES)
+        option_price[j] = opt.price
+        option_zone[j] = zone_ids[opt.zone]
+        option_captype[j] = 1 if opt.capacity_type == wk.CAPACITY_TYPE_SPOT else 0
+        option_reqs.append(_option_requirements(opt, it, pool))
+        option_taints.append(pool.template.taints)
+
+    # pod equivalence classes
+    classes: Dict[tuple, int] = {}
+    members: List[List[int]] = []
+    reps: List[Pod] = []
+    for i, pod in enumerate(pods):
+        k = _class_key(pod)
+        ci = classes.get(k)
+        if ci is None:
+            ci = classes[k] = len(members)
+            members.append([])
+            reps.append(pod)
+        members[ci].append(i)
+
+    C = len(reps)
+    class_requests = np.zeros((C, R), np.float32)
+    class_compat = np.zeros((C, O), bool)
+    for ci, rep in enumerate(reps):
+        req = ResourceList(rep.requests)
+        req[PODS] = req.get(PODS, 0) + 1  # every pod consumes one pod slot
+        class_requests[ci] = req.to_vector(axes, DEFAULT_SCALES, round_up=True)
+        branches = rep.scheduling_requirements()
+        for j in range(O):
+            if not tolerates_all(rep.tolerations, option_taints[j]):
+                continue
+            # Fail closed on keys the option can't provide: a pod requiring a
+            # user label schedules only if some NodePool template carries it
+            # (reference scheduling.md label rules); complemented ops (NotIn/
+            # DoesNotExist) tolerate absence via Requirements.compatible.
+            provided = option_reqs[j]
+            if any(b.compatible(provided) for b in branches):
+                class_compat[ci, j] = True
+
+    return Problem(
+        axes=axes,
+        class_requests=class_requests,
+        class_counts=np.asarray([len(m) for m in members], np.int32),
+        class_compat=class_compat,
+        class_members=members,
+        options=options,
+        option_alloc=option_alloc,
+        option_price=option_price,
+        option_rank=option_rank,
+        option_zone=option_zone,
+        option_captype=option_captype,
+        zones=zones,
+        pods=list(pods),
+    )
+
+
+def pad_to(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)) -> int:
+    """Bucketed padding to bound jit recompiles (SURVEY.md §7 hard part iv)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(2 ** math.ceil(math.log2(max(n, 1))))

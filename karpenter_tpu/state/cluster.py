@@ -1,0 +1,151 @@
+"""In-memory cluster state.
+
+Analog of karpenter-core's `state.Cluster` (constructed at
+/root/reference/cmd/controller/main.go:51): the nodes+pods+bindings snapshot
+that provisioning packs against and the consolidation simulator replays.
+
+TPU-first addition: `tensorize_nodes` lowers the live node set to the dense
+arrays (allocatable/used E×R, per-class compat C×E) that the packing kernel
+takes as pre-opened slots, so "schedule against existing capacity" and
+"simulate without node X" are array slices, not object-graph walks."""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as wk
+from ..api.objects import Node, NodeClaim, Pod
+from ..api.requirements import Requirements
+from ..api.resources import DEFAULT_AXES, DEFAULT_SCALES, PODS, ResourceList
+from ..api.taints import tolerates_all
+
+_names = itertools.count(1)
+
+
+class Cluster:
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self.clock = clock
+        self.nodes: Dict[str, Node] = {}
+        self.nodeclaims: Dict[str, NodeClaim] = {}
+        self.pods: Dict[str, Pod] = {}          # uid -> pod (all known pods)
+
+    # ---- pods ----
+    def add_pod(self, pod: Pod) -> Pod:
+        self.pods[pod.uid] = pod
+        return pod
+
+    def add_pods(self, pods: Sequence[Pod]) -> List[Pod]:
+        return [self.add_pod(p) for p in pods]
+
+    def delete_pod(self, pod: Pod):
+        self.pods.pop(pod.uid, None)
+        if pod.node_name and pod.node_name in self.nodes:
+            node = self.nodes[pod.node_name]
+            node.pods = [p for p in node.pods if p.uid != pod.uid]
+
+    def bind_pod(self, pod: Pod, node_name: str):
+        if pod.node_name and pod.node_name in self.nodes:
+            old = self.nodes[pod.node_name]
+            old.pods = [p for p in old.pods if p.uid != pod.uid]
+        pod.node_name = node_name
+        self.nodes[node_name].pods.append(pod)
+
+    def unbind_pod(self, pod: Pod):
+        if pod.node_name and pod.node_name in self.nodes:
+            node = self.nodes[pod.node_name]
+            node.pods = [p for p in node.pods if p.uid != pod.uid]
+        pod.node_name = ""
+
+    def pending_pods(self) -> List[Pod]:
+        return [p for p in self.pods.values() if not p.node_name]
+
+    # ---- nodes / claims ----
+    def add_node(self, node: Node) -> Node:
+        self.nodes[node.name] = node
+        return node
+
+    def remove_node(self, name: str) -> Optional[Node]:
+        node = self.nodes.pop(name, None)
+        if node:
+            for p in node.pods:
+                p.node_name = ""
+                # evicted pods with owners get recreated as pending; ownerless
+                # pods are gone for good (termination semantics)
+                if not p.owner_kind:
+                    self.pods.pop(p.uid, None)
+            node.pods = []
+        return node
+
+    def register_nodeclaim(self, claim: NodeClaim, allocatable: ResourceList,
+                           capacity: Optional[ResourceList] = None) -> Node:
+        """NodeClaim → Node on (simulated) kubelet join; lifecycle per
+        SURVEY §2.2 NodeClaim lifecycle."""
+        claim.registered = True
+        claim.initialized = True
+        self.nodeclaims[claim.name] = claim
+        node = Node(
+            name=f"node-{next(_names):06d}",
+            provider_id=claim.provider_id,
+            labels=dict(claim.labels),
+            taints=list(claim.taints),
+            allocatable=allocatable,
+            capacity=capacity or allocatable,
+            nodepool=claim.nodepool,
+            instance_type=claim.instance_type,
+            zone=claim.zone,
+            capacity_type=claim.capacity_type,
+            price=claim.price,
+            created_at=self.clock(),
+        )
+        node.labels.setdefault(wk.HOSTNAME, node.name)
+        return self.add_node(node)
+
+    def node_for_provider_id(self, provider_id: str) -> Optional[Node]:
+        for n in self.nodes.values():
+            if n.provider_id == provider_id:
+                return n
+        return None
+
+    def nodepool_usage(self) -> Dict[str, ResourceList]:
+        """Capacity in use per NodePool — feeds limits enforcement
+        (/root/reference/designs/limits.md)."""
+        out: Dict[str, ResourceList] = {}
+        for n in self.nodes.values():
+            if n.nodepool:
+                out[n.nodepool] = out.get(n.nodepool, ResourceList()) + n.capacity
+        return out
+
+    # ---- tensorization of live capacity ----
+    def tensorize_nodes(self, pod_classes: Sequence[Pod],
+                        axes: Tuple[str, ...] = DEFAULT_AXES,
+                        exclude: Sequence[str] = (),
+                        nodes: Optional[Sequence[Node]] = None):
+        """Lower live nodes to pre-opened packing slots.
+
+        Returns (node_list, alloc E×R, used E×R, compat C×E) where compat is
+        label/taint feasibility of each pod class rep on each node. `exclude`
+        masks candidate nodes out — the consolidation simulator's "what if
+        this node were gone" (SURVEY.md §7.6)."""
+        node_list = [n for n in (nodes if nodes is not None else self.nodes.values())
+                     if n.name not in exclude and not n.marked_for_deletion]
+        E, R, C = len(node_list), len(axes), len(pod_classes)
+        alloc = np.zeros((E, R), np.float32)
+        used = np.zeros((E, R), np.float32)
+        compat = np.zeros((C, E), bool)
+        for e, n in enumerate(node_list):
+            alloc[e] = n.allocatable.to_vector(axes, DEFAULT_SCALES)
+            req = n.requested()
+            req[PODS] = len(n.pods)
+            used[e] = req.to_vector(axes, DEFAULT_SCALES, round_up=True)
+            provided = Requirements.from_labels(n.labels)
+            for ci, rep in enumerate(pod_classes):
+                if not tolerates_all(rep.tolerations, n.taints):
+                    continue
+                if any(b.compatible(provided) for b in rep.scheduling_requirements()):
+                    compat[ci, e] = True
+        return node_list, alloc, used, compat

@@ -1,0 +1,91 @@
+"""Shared test fixtures: small catalogs, pods, and a pure-Python oracle
+packer (the obviously-correct slow implementation the JAX kernels are
+checked against)."""
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool, NodePoolTemplate, Pod
+from karpenter_tpu.api.resources import CPU, GPU, MEMORY, ResourceList
+from karpenter_tpu.catalog import GiB, InstanceTypeInfo, Offering, new_instance_type
+from karpenter_tpu.ops.tensorize import Problem
+
+
+def make_type(name, cpu, mem_gib, price, zones=("zone-a", "zone-b"),
+              spot_discount=0.0, gpu_count=0, arch="amd64"):
+    info = InstanceTypeInfo(name=name, cpu_m=cpu * 1000,
+                            memory_bytes=mem_gib * GiB, arch=arch,
+                            gpu_count=gpu_count, gpu_name="a10g" if gpu_count else "")
+    offerings = []
+    for z in zones:
+        offerings.append(Offering(z, "on-demand", price))
+        if spot_discount:
+            offerings.append(Offering(z, "spot", price * (1 - spot_discount)))
+    return new_instance_type(info, offerings)
+
+
+def small_catalog():
+    return [
+        make_type("a.small", 2, 4, 0.10),
+        make_type("a.medium", 4, 8, 0.20),
+        make_type("a.large", 8, 16, 0.40),
+        make_type("a.xlarge", 16, 32, 0.80),
+    ]
+
+
+def cpu_pod(cpu_m=500, mem_mib=512, **kw):
+    return Pod(requests=ResourceList({CPU: cpu_m, MEMORY: mem_mib * 2**20}), **kw)
+
+
+def oracle_ffd(problem: Problem,
+               existing_alloc: Optional[np.ndarray] = None,
+               existing_used: Optional[np.ndarray] = None,
+               existing_compat: Optional[np.ndarray] = None):
+    """Pure-Python first-fit-decreasing with cheapest-new-node: the oracle the
+    scan kernel must match exactly (same ordering rules)."""
+    requests, compat, pod_idx = problem.expand()
+    alloc = problem.option_alloc
+    price = problem.option_price
+    E = 0 if existing_alloc is None else len(existing_alloc)
+    nodes = []  # list of dict(option=..., used=np.ndarray, existing=bool)
+    if E:
+        class_ids = np.repeat(np.arange(problem.num_classes), problem.class_counts)
+        norm = problem.option_alloc.mean(axis=0)
+        norm = np.where(norm > 0, norm, 1.0)
+        size = (problem.class_requests[class_ids] / norm).sum(axis=1)
+        order = np.argsort(-size, kind="stable")
+        ec = existing_compat if existing_compat is not None else \
+            np.ones((problem.num_classes, E), bool)
+        compat_exist = ec[class_ids][order]
+        for e in range(E):
+            used = existing_used[e].copy() if existing_used is not None else np.zeros(alloc.shape[1])
+            nodes.append(dict(option=None, alloc=existing_alloc[e], used=used,
+                              existing=True, pods=[], idx=e))
+    assignment = {}
+    unschedulable = []
+    for row in range(len(requests)):
+        req = requests[row]
+        placed = False
+        for n in nodes:
+            ok = compat[row, n["option"]] if n["option"] is not None else \
+                (compat_exist[row, n["idx"]] if E else True)
+            if ok and np.all(n["used"] + req <= n["alloc"]):
+                n["used"] = n["used"] + req
+                n["pods"].append(int(pod_idx[row]))
+                placed = True
+                break
+        if placed:
+            continue
+        cand = [j for j in range(len(alloc))
+                if compat[row, j] and np.all(req <= alloc[j])]
+        if not cand:
+            unschedulable.append(int(pod_idx[row]))
+            continue
+        j = min(cand)  # options pre-sorted by (price, name…)
+        nodes.append(dict(option=j, alloc=alloc[j].copy(), used=req.copy(),
+                          existing=False, pods=[int(pod_idx[row])], idx=None))
+    new_nodes = [n for n in nodes if not n["existing"]]
+    total = sum(price[n["option"]] for n in new_nodes)
+    return new_nodes, unschedulable, float(total)

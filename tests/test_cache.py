@@ -1,0 +1,70 @@
+from karpenter_tpu.cloud.cache import TTLCache, UnavailableOfferings
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_ttl_cache_expiry():
+    clk = FakeClock()
+    c = TTLCache(60, clock=clk)
+    c.set("k", "v")
+    assert c.get("k") == "v" and "k" in c
+    clk.t += 61
+    assert c.get("k") is None and "k" not in c
+
+
+def test_unavailable_offerings_mark_and_expire():
+    clk = FakeClock()
+    u = UnavailableOfferings(ttl=180, clock=clk)
+    s0 = u.seq_num
+    u.mark_unavailable("ice", "m5.large", "zone-a", "spot")
+    assert u.is_unavailable("spot", "m5.large", "zone-a")
+    assert not u.is_unavailable("on-demand", "m5.large", "zone-a")
+    s1 = u.seq_num
+    assert s1 > s0
+    # TTL expiry must bump the seq so memoized catalogs re-admit the offering
+    clk.t += 181
+    assert not u.is_unavailable("spot", "m5.large", "zone-a")
+    assert u.seq_num > s1
+
+
+def test_seq_bump_without_reads():
+    # the catalog memo checks seq_num BEFORE any is_unavailable call —
+    # expiry must be detected by seq_num itself
+    clk = FakeClock()
+    u = UnavailableOfferings(ttl=60, clock=clk)
+    u.mark_unavailable("ice", "t", "z", "spot")
+    s = u.seq_num
+    clk.t += 61
+    assert u.seq_num > s
+
+
+def test_delete_and_flush_bump_seq():
+    u = UnavailableOfferings()
+    u.mark_unavailable("ice", "t", "z", "spot")
+    s = u.seq_num
+    u.delete("t", "z", "spot")
+    assert u.seq_num > s
+    s = u.seq_num
+    u.flush()
+    assert u.seq_num > s
+
+
+def test_catalog_readmits_after_expiry():
+    """End-to-end: InstanceTypesProvider memo refreshes on TTL expiry."""
+    from helpers import make_type
+    from karpenter_tpu.cloud.provider import InstanceTypesProvider
+
+    clk = FakeClock()
+    u = UnavailableOfferings(ttl=180, clock=clk)
+    prov = InstanceTypesProvider([make_type("a.small", 2, 4, 0.1, zones=("zone-a",))], u)
+    u.mark_unavailable("ice", "a.small", "zone-a", "on-demand")
+    assert prov.list() == []          # everything masked
+    clk.t += 181
+    lst = prov.list()
+    assert len(lst) == 1 and lst[0].offerings[0].available

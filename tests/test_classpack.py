@@ -1,0 +1,141 @@
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool, Pod
+from karpenter_tpu.api.resources import (CPU, DEFAULT_SCALES, GPU, MEMORY,
+                                         PODS, ResourceList)
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.ops import solve_classpack, solve_ffd, tensorize
+
+
+def validate_packing(problem, result):
+    """Every decoded node must honor capacity and compatibility — the
+    invariant any packer must satisfy regardless of heuristic."""
+    for node in result.nodes:
+        oi = problem.options.index(node.option)
+        alloc = problem.option_alloc[oi]
+        used = np.zeros(len(problem.axes))
+        for p in node.pod_indices:
+            ci = next(c for c, m in enumerate(problem.class_members) if p in m)
+            used += problem.class_requests[ci]
+            assert problem.class_compat[ci, oi], \
+                f"pod {p} (class {ci}) incompatible with {node.option}"
+        assert (used <= alloc + 1e-6).all(), \
+            f"node {node.option.instance_type} overfilled: {used} > {alloc}"
+    counted = (sum(len(n.pod_indices) for n in result.nodes)
+               + len(result.existing_assignments) + len(result.unschedulable))
+    assert counted == len(problem.pods)
+
+
+def test_single_class_packs_full_nodes():
+    pods = [cpu_pod(cpu_m=400, mem_mib=256) for _ in range(20)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    res = solve_classpack(prob)
+    validate_packing(prob, res)
+    assert not res.unschedulable
+    # price-per-pod heuristic should use few nodes
+    assert len(res.nodes) <= 5
+
+
+def test_mixed_classes():
+    pods = ([cpu_pod(cpu_m=1500, mem_mib=2048) for _ in range(10)]
+            + [cpu_pod(cpu_m=200, mem_mib=128) for _ in range(30)])
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    res = solve_classpack(prob)
+    validate_packing(prob, res)
+    assert not res.unschedulable
+
+
+def test_small_classes_fill_gaps():
+    # large pods leave gaps; small pods must fill them before new nodes open
+    pods = [cpu_pod(cpu_m=1200, mem_mib=512) for _ in range(3)] + \
+           [cpu_pod(cpu_m=100, mem_mib=64) for _ in range(6)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    res = solve_classpack(prob)
+    validate_packing(prob, res)
+    ffd = solve_ffd(prob)
+    assert res.total_price <= ffd.total_price + 1e-6
+
+
+def test_unschedulable_counted():
+    pods = [cpu_pod(cpu_m=10**6) for _ in range(3)] + [cpu_pod(cpu_m=100)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    res = solve_classpack(prob)
+    assert len(res.unschedulable) == 3
+    assert sum(len(n.pod_indices) for n in res.nodes) == 1
+
+
+def test_existing_capacity_consumed_first():
+    pods = [cpu_pod(cpu_m=300, mem_mib=128) for _ in range(4)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    R = len(prob.axes)
+    alloc = np.zeros((1, R), np.float32)
+    alloc[0, prob.axes.index(CPU)] = 2000
+    alloc[0, prob.axes.index(MEMORY)] = 4096   # MiB (scaled units)
+    alloc[0, prob.axes.index(PODS)] = 110
+    res = solve_classpack(prob, existing_alloc=alloc,
+                          existing_used=np.zeros((1, R), np.float32))
+    assert not res.nodes
+    assert len(res.existing_assignments) == 4
+
+
+def test_existing_partial_then_new():
+    pods = [cpu_pod(cpu_m=900, mem_mib=128) for _ in range(4)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    R = len(prob.axes)
+    alloc = np.zeros((1, R), np.float32)
+    alloc[0, prob.axes.index(CPU)] = 2000
+    alloc[0, prob.axes.index(MEMORY)] = 4096
+    alloc[0, prob.axes.index(PODS)] = 110
+    res = solve_classpack(prob, existing_alloc=alloc,
+                          existing_used=np.zeros((1, R), np.float32))
+    # 2 pods fit the existing node (2000/900), 2 overflow to one new node
+    assert len(res.existing_assignments) == 2
+    assert sum(len(n.pod_indices) for n in res.nodes) == 2
+    validate_packing(prob, res)
+
+
+def test_matches_scale_and_quality():
+    rng = np.random.default_rng(3)
+    cat = generate_catalog(60)
+    specs = [(int(rng.integers(100, 4000)), int(rng.integers(128, 8192)))
+             for _ in range(12)]
+    pods = [cpu_pod(cpu_m=c, mem_mib=m) for c, m in specs for _ in range(40)]
+    prob = tensorize(pods, cat, [NodePool()])
+    assert prob.num_classes == 12
+    res = solve_classpack(prob)
+    validate_packing(prob, res)
+    assert not res.unschedulable
+    # quality: the price-per-pod heuristic should not lose to plain FFD
+    ffd = solve_ffd(prob)
+    assert res.total_price <= ffd.total_price * 1.05
+
+
+def test_decode_false_aggregates_only():
+    pods = [cpu_pod(cpu_m=500, mem_mib=256) for _ in range(10)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    full = solve_classpack(prob, decode=True)
+    agg = solve_classpack(prob, decode=False)
+    assert agg.total_price == pytest.approx(full.total_price)
+    assert len(agg.nodes) == len(full.nodes)
+
+
+def test_gpu_classes():
+    cat = small_catalog() + [make_type("g.xlarge", 8, 32, 1.2, gpu_count=4)]
+    pods = [Pod(requests=ResourceList({CPU: 500, GPU: 1})) for _ in range(8)]
+    prob = tensorize(pods, cat, [NodePool()])
+    res = solve_classpack(prob)
+    validate_packing(prob, res)
+    assert len(res.nodes) == 2  # 8 single-gpu pods on two 4-gpu nodes
+    assert all(n.option.instance_type == "g.xlarge" for n in res.nodes)
+
+
+def test_determinism():
+    pods = [cpu_pod(cpu_m=700, mem_mib=300) for _ in range(50)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    r1 = solve_classpack(prob)
+    r2 = solve_classpack(prob)
+    assert [n.option for n in r1.nodes] == [n.option for n in r2.nodes]
+    assert r1.total_price == r2.total_price

@@ -1,0 +1,131 @@
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type, oracle_ffd, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool, Pod
+from karpenter_tpu.api.resources import CPU, GPU, MEMORY, PODS, ResourceList
+from karpenter_tpu.ops import solve_ffd, tensorize
+
+
+def solve(pods, catalog=None, pools=None, **kw):
+    prob = tensorize(pods, catalog or small_catalog(), pools or [NodePool()])
+    return prob, solve_ffd(prob, **kw)
+
+
+def test_single_pod_cheapest_node():
+    prob, res = solve([cpu_pod(cpu_m=500)])
+    assert len(res.nodes) == 1
+    assert res.nodes[0].option.instance_type == "a.small"
+    assert not res.unschedulable
+
+
+def test_large_pod_skips_too_small():
+    # a.small allocatable cpu < 3000m once kube-reserved is shaved
+    prob, res = solve([cpu_pod(cpu_m=3000)])
+    assert res.nodes[0].option.instance_type == "a.medium"
+
+
+def test_pods_pack_onto_one_node():
+    prob, res = solve([cpu_pod(cpu_m=400, mem_mib=256) for _ in range(4)])
+    assert len(res.nodes) == 1
+    assert len(res.nodes[0].pod_indices) == 4
+
+
+def test_overflow_opens_second_node():
+    # a.small allocatable ≈ 1900m cpu → 4 pods of 800m need >1 node
+    prob, res = solve([cpu_pod(cpu_m=800, mem_mib=128) for _ in range(4)])
+    assert len(res.nodes) >= 2
+    assert res.scheduled_count == 4
+
+
+def test_unschedulable_pod():
+    prob, res = solve([cpu_pod(cpu_m=64_000)])
+    assert res.unschedulable == [0]
+    assert not res.nodes
+
+
+def test_pods_resource_respected():
+    # 110-pod ceiling: 150 tiny pods can't share one node
+    prob, res = solve([cpu_pod(cpu_m=1, mem_mib=1) for _ in range(150)])
+    assert res.scheduled_count == 150
+    assert len(res.nodes) >= 2
+
+
+def test_matches_oracle_random():
+    rng = np.random.default_rng(42)
+    pods = [cpu_pod(cpu_m=int(rng.integers(50, 4000)),
+                    mem_mib=int(rng.integers(64, 8192))) for _ in range(60)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    res = solve_ffd(prob)
+    nodes_o, unsched_o, total_o = oracle_ffd(prob)
+    assert len(res.nodes) == len(nodes_o)
+    assert res.total_price == pytest.approx(total_o)
+    assert sorted(res.unschedulable) == sorted(unsched_o)
+    got = sorted(tuple(sorted(n.pod_indices)) for n in res.nodes)
+    want = sorted(tuple(sorted(n["pods"])) for n in nodes_o)
+    assert got == want
+
+
+def test_matches_oracle_with_constraints():
+    rng = np.random.default_rng(7)
+    cat = small_catalog() + [make_type("g.xlarge", 8, 32, 1.2, gpu_count=4)]
+    pods = []
+    for i in range(40):
+        if i % 5 == 0:
+            pods.append(Pod(requests=ResourceList({CPU: 500, GPU: 1})))
+        elif i % 3 == 0:
+            pods.append(cpu_pod(cpu_m=int(rng.integers(100, 2000)),
+                                node_selector={wk.ZONE: "zone-a"}))
+        else:
+            pods.append(cpu_pod(cpu_m=int(rng.integers(100, 2000))))
+    prob = tensorize(pods, cat, [NodePool()])
+    res = solve_ffd(prob)
+    nodes_o, unsched_o, total_o = oracle_ffd(prob)
+    assert res.total_price == pytest.approx(total_o)
+    assert len(res.nodes) == len(nodes_o)
+    # GPU pods all landed on the gpu type
+    for n in res.nodes:
+        gpu_pods = [p for p in n.pod_indices if p % 5 == 0]
+        if gpu_pods:
+            assert n.option.instance_type == "g.xlarge"
+
+
+def test_existing_nodes_used_first():
+    prob = tensorize([cpu_pod(cpu_m=500, mem_mib=256)], small_catalog(), [NodePool()])
+    R = len(prob.axes)
+    existing_alloc = np.zeros((1, R), np.float32)
+    existing_alloc[0, prob.axes.index(CPU)] = 2000
+    existing_alloc[0, prob.axes.index(MEMORY)] = 4 * 2**30
+    existing_alloc[0, prob.axes.index(PODS)] = 110
+    res = solve_ffd(prob, existing_alloc=existing_alloc,
+                    existing_used=np.zeros((1, R), np.float32))
+    assert not res.nodes                      # no new launch
+    assert res.existing_assignments == {0: 0}
+
+
+def test_existing_node_full_falls_through():
+    prob = tensorize([cpu_pod(cpu_m=500, mem_mib=256)], small_catalog(), [NodePool()])
+    R = len(prob.axes)
+    existing_alloc = np.zeros((1, R), np.float32)
+    existing_alloc[0, prob.axes.index(CPU)] = 2000
+    existing_used = existing_alloc.copy()     # full
+    res = solve_ffd(prob, existing_alloc=existing_alloc, existing_used=existing_used)
+    assert len(res.nodes) == 1
+    assert not res.existing_assignments
+
+
+def test_alternatives_are_supersets():
+    prob, res = solve([cpu_pod(cpu_m=500, mem_mib=256)])
+    alts = res.nodes[0].alternatives
+    assert res.nodes[0].option in alts
+    # alternatives are price-ordered
+    prices = [a.price for a in alts]
+    assert prices == sorted(prices)
+
+
+def test_deterministic():
+    pods = [cpu_pod(cpu_m=700, mem_mib=700) for _ in range(25)]
+    _, r1 = solve(pods)
+    _, r2 = solve(pods)
+    assert [n.option for n in r1.nodes] == [n.option for n in r2.nodes]

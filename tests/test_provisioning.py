@@ -1,0 +1,195 @@
+"""End-to-end slice: pending pods → solver → fake launches → cluster state.
+
+Analog of the reference's full-stack-in-process tests
+(/root/reference/pkg/cloudprovider/suite_test.go:87-177: real scheduler over
+fake cloud + ExpectProvisioned)."""
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool, NodePoolTemplate, Pod
+from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+from karpenter_tpu.api.resources import CPU, GPU, MEMORY, ResourceList
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud import (FakeCloud, CloudProvider, ICE_CODE,
+                                 InsufficientCapacityError)
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.state import Cluster
+
+
+def env(catalog=None, pools=None):
+    cloud = FakeCloud()
+    provider = CloudProvider(cloud, catalog or small_catalog())
+    cluster = Cluster()
+    prov = Provisioner(provider, cluster, pools or [NodePool()])
+    return cloud, provider, cluster, prov
+
+
+def test_provision_single_pod():
+    cloud, provider, cluster, prov = env()
+    cluster.add_pod(cpu_pod(cpu_m=500))
+    res = prov.provision()
+    assert len(res.launched) == 1
+    assert res.launched[0].instance_type == "a.small"
+    assert res.launched[0].provider_id.startswith("i-")
+    assert len(cloud.running()) == 1
+    assert not cluster.pending_pods()
+
+
+def test_provision_batch_packs():
+    cloud, provider, cluster, prov = env()
+    cluster.add_pods([cpu_pod(cpu_m=400, mem_mib=256) for _ in range(8)])
+    res = prov.provision()
+    assert res.scheduled == 8
+    # packed onto few nodes, not one per pod
+    assert len(res.launched) < 8
+    for n in cluster.nodes.values():
+        assert len(n.pods) >= 1
+
+
+def test_second_round_uses_existing_capacity():
+    cloud, provider, cluster, prov = env()
+    cluster.add_pod(cpu_pod(cpu_m=200, mem_mib=128))
+    r1 = prov.provision()
+    assert len(r1.launched) == 1
+    cluster.add_pod(cpu_pod(cpu_m=200, mem_mib=128))
+    r2 = prov.provision()
+    assert len(r2.launched) == 0
+    assert r2.bound_existing == 1
+    assert len(cloud.running()) == 1
+
+
+def test_ice_fallback_to_other_pool():
+    cat = small_catalog()
+    cloud, provider, cluster, prov = env(cat)
+    # cheapest option for a small pod is a.small — ICE it everywhere
+    for z in ("zone-a", "zone-b"):
+        cloud.insufficient_capacity_pools.add(("on-demand", "a.small", z))
+    cluster.add_pod(cpu_pod(cpu_m=500))
+    res = prov.provision()
+    # CreateFleet falls through to the next type in the same call
+    assert len(res.launched) == 1
+    assert res.launched[0].instance_type != "a.small"
+    # and the ICE cache was fed
+    assert provider.unavailable.is_unavailable("on-demand", "a.small", "zone-a")
+
+
+def test_ice_total_leaves_pending_then_recovers():
+    cat = [make_type("only.type", 4, 8, 0.2, zones=("zone-a",))]
+    cloud, provider, cluster, prov = env(cat)
+    cloud.insufficient_capacity_pools.add(("on-demand", "only.type", "zone-a"))
+    pod = cluster.add_pod(cpu_pod(cpu_m=500))
+    res = prov.provision()
+    assert not res.launched and cluster.pending_pods()
+    # capacity returns
+    cloud.insufficient_capacity_pools.clear()
+    provider.unavailable.flush()
+    res2 = prov.provision()
+    assert len(res2.launched) == 1
+    assert not cluster.pending_pods()
+
+
+def test_nodepool_limits_stop_provisioning():
+    pool = NodePool(limits=ResourceList({CPU: 2000}))
+    cloud, provider, cluster, prov = env(pools=[pool])
+    cluster.add_pod(cpu_pod(cpu_m=1000))
+    r1 = prov.provision()
+    assert len(r1.launched) == 1
+    # pool capacity (a.small = 2000m) now ≥ limit → no more launches
+    cluster.add_pod(cpu_pod(cpu_m=4000))
+    r2 = prov.provision()
+    assert not r2.launched
+    assert cluster.pending_pods()
+
+
+def test_weighted_pool_preferred_over_cheaper():
+    # weight precedence: the heavy pool wins even when the light pool's
+    # options are cheaper (reference NodePool.spec.weight semantics)
+    heavy = NodePool(name="reserved", weight=100, template=NodePoolTemplate(
+        requirements=Requirements.of(
+            Requirement(wk.INSTANCE_FAMILY, IN, ["a"]),
+            Requirement("node.kubernetes.io/instance-type", IN, ["a.medium"]))))
+    light = NodePool(name="cheap")
+    cloud, provider, cluster, prov = env(pools=[heavy, light])
+    cluster.add_pod(cpu_pod(cpu_m=500))
+    res = prov.provision()
+    assert res.launched[0].nodepool == "reserved"
+    assert res.launched[0].instance_type == "a.medium"  # not the cheaper a.small
+
+
+def test_taints_and_weighted_pools():
+    tainted = NodePool(
+        name="gpu", weight=10,
+        template=NodePoolTemplate(
+            taints=[__import__("karpenter_tpu.api.taints", fromlist=["Taint"]).Taint("gpu")]))
+    default = NodePool(name="default")
+    cloud, provider, cluster, prov = env(pools=[tainted, default])
+    cluster.add_pod(cpu_pod(cpu_m=500))
+    res = prov.provision()
+    assert res.launched[0].nodepool == "default"
+
+
+def test_zone_selector_respected_at_launch():
+    cloud, provider, cluster, prov = env()
+    cluster.add_pod(cpu_pod(cpu_m=500, node_selector={wk.ZONE: "zone-b"}))
+    res = prov.provision()
+    assert res.launched[0].zone == "zone-b"
+    assert cloud.running()[0].zone == "zone-b"
+
+
+def test_gpu_pods_on_gpu_nodes():
+    cat = small_catalog() + [make_type("g.xlarge", 8, 32, 1.2, gpu_count=4)]
+    cloud, provider, cluster, prov = env(cat)
+    cluster.add_pods([Pod(requests=ResourceList({CPU: 500, GPU: 1})) for _ in range(4)])
+    res = prov.provision()
+    assert res.scheduled == 4
+    assert all(c.instance_type == "g.xlarge" for c in res.launched)
+    # 4 single-gpu pods pack onto one 4-gpu node
+    assert len(res.launched) == 1
+
+
+def test_unschedulable_pod_reported():
+    cloud, provider, cluster, prov = env()
+    giant = cpu_pod(cpu_m=10**6)
+    cluster.add_pod(giant)
+    res = prov.provision()
+    assert res.unschedulable and res.unschedulable[0].uid == giant.uid
+    assert cluster.pending_pods()
+
+
+def test_spot_preferred_when_allowed():
+    cat = [make_type("s.large", 4, 8, 0.2, spot_discount=0.7)]
+    pool = NodePool(template=NodePoolTemplate(requirements=Requirements.of(
+        Requirement(wk.CAPACITY_TYPE, IN, ["spot", "on-demand"]))))
+    cloud, provider, cluster, prov = env(cat, [pool])
+    cluster.add_pod(cpu_pod(cpu_m=500))
+    res = prov.provision()
+    assert res.launched[0].capacity_type == "spot"
+
+
+def test_generated_catalog_scale():
+    cat = generate_catalog(200)
+    assert len(cat) == 200
+    cloud, provider, cluster, prov = env(cat)
+    rng = np.random.default_rng(0)
+    pods = [cpu_pod(cpu_m=int(rng.integers(100, 4000)),
+                    mem_mib=int(rng.integers(128, 16384))) for _ in range(200)]
+    cluster.add_pods(pods)
+    res = prov.provision()
+    assert res.scheduled == 200
+    assert not cluster.pending_pods()
+    total_cap = sum(len(n.pods) for n in cluster.nodes.values())
+    assert total_cap == 200
+
+
+def test_node_labels_populated():
+    cloud, provider, cluster, prov = env()
+    cluster.add_pod(cpu_pod(cpu_m=500))
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    assert node.labels[wk.INSTANCE_TYPE] == "a.small"
+    assert node.labels[wk.NODEPOOL] == "default"
+    assert node.labels[wk.ZONE] in ("zone-a", "zone-b")
+    assert wk.HOSTNAME in node.labels

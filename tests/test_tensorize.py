@@ -1,0 +1,125 @@
+import numpy as np
+
+from helpers import cpu_pod, make_type, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool, NodePoolTemplate, Pod
+from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+from karpenter_tpu.api.resources import CPU, GPU, PODS, ResourceList
+from karpenter_tpu.api.taints import Taint, Toleration
+from karpenter_tpu.ops import build_options, pad_to, tensorize
+
+
+def test_options_flattened_and_price_sorted():
+    cat = small_catalog()
+    prob = tensorize([cpu_pod()], cat, [NodePool()])
+    # 4 types × 2 zones × on-demand
+    assert prob.num_options == 8
+    prices = prob.option_price
+    assert (np.diff(prices) >= 0).all()
+
+
+def test_options_respect_nodepool_requirements():
+    cat = small_catalog()
+    pool = NodePool(name="zoned", template=NodePoolTemplate(
+        requirements=Requirements.of(Requirement(wk.ZONE, IN, ["zone-a"]))))
+    opts = build_options(cat, [pool])
+    assert all(o.zone == "zone-a" for o in opts)
+    pool2 = NodePool(name="fam", template=NodePoolTemplate(
+        requirements=Requirements.of(Requirement(wk.INSTANCE_FAMILY, IN, ["nope"]))))
+    assert build_options(cat, [pool2]) == []
+
+
+def test_unavailable_offerings_masked():
+    it = make_type("a.small", 2, 4, 0.10, zones=("zone-a",))
+    it.offerings[0].available = False
+    prob = tensorize([cpu_pod()], [it], [NodePool()])
+    assert prob.num_options == 0
+
+
+def test_class_grouping():
+    pods = [cpu_pod() for _ in range(10)] + [cpu_pod(cpu_m=2000) for _ in range(5)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    assert prob.num_classes == 2
+    assert sorted(prob.class_counts.tolist()) == [5, 10]
+    assert sum(len(m) for m in prob.class_members) == 15
+
+
+def test_pod_slot_resource_added():
+    prob = tensorize([cpu_pod()], small_catalog(), [NodePool()])
+    pods_axis = prob.axes.index(PODS)
+    assert prob.class_requests[0, pods_axis] == 1.0
+
+
+def test_compat_zone_selector():
+    pods = [cpu_pod(node_selector={wk.ZONE: "zone-b"})]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    compat = prob.class_compat[0]
+    for j, ok in enumerate(compat):
+        assert ok == (prob.options[j].zone == "zone-b")
+
+
+def test_compat_user_label_fails_closed():
+    # pod requiring a label no NodePool provides never schedules (scheduling.md rules)
+    pods = [cpu_pod(node_selector={"team": "ml"})]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    assert prob.num_options > 0
+    assert not prob.class_compat.any()
+    # but schedules when the pool's template carries the label
+    pool = NodePool(template=NodePoolTemplate(labels={"team": "ml"}))
+    prob2 = tensorize(pods, small_catalog(), [pool])
+    assert prob2.num_options > 0         # pool labels must not kill options
+    assert prob2.class_compat.all()
+
+
+def test_labeled_pool_keeps_options():
+    # regression: a template label used to fail-closed against the catalog
+    # and produce zero launch options for the whole pool
+    pool = NodePool(template=NodePoolTemplate(labels={"team": "ml"}))
+    opts = build_options(small_catalog(), [pool])
+    assert len(opts) == 8
+
+
+def test_compat_taints():
+    tainted = NodePool(name="t", template=NodePoolTemplate(taints=[Taint("gpu")]))
+    prob = tensorize([cpu_pod()], small_catalog(), [tainted])
+    assert prob.num_options > 0
+    assert not prob.class_compat.any()
+    prob2 = tensorize([cpu_pod(tolerations=[Toleration("gpu", "Exists")])],
+                      small_catalog(), [tainted])
+    assert prob2.num_options > 0
+    assert prob2.class_compat.all()
+
+
+def test_gpu_requests_limit_compat():
+    cat = small_catalog() + [make_type("g.xlarge", 8, 32, 1.2, gpu_count=4)]
+    pod = Pod(requests=ResourceList({CPU: 1000, GPU: 2}))
+    prob = tensorize([pod], cat, [NodePool()])
+    # compat mask itself only covers label/taint feasibility; resource fit is
+    # the kernel's job — but requests vector must carry the GPU axis
+    gpu_axis = prob.axes.index(GPU)
+    assert prob.class_requests[0, gpu_axis] == 2
+
+
+def test_expand_sorts_descending():
+    pods = [cpu_pod(cpu_m=100), cpu_pod(cpu_m=4000), cpu_pod(cpu_m=1000)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    req, _, pod_idx = prob.expand()
+    cpu_axis = prob.axes.index(CPU)
+    assert list(req[:, cpu_axis]) == [4000.0, 1000.0, 100.0]
+    assert list(pod_idx) == [1, 2, 0]
+
+
+def test_multiple_nodepools_weighted_options():
+    cat = small_catalog()
+    a = NodePool(name="a")
+    b = NodePool(name="b", template=NodePoolTemplate(
+        requirements=Requirements.of(Requirement(wk.INSTANCE_FAMILY, IN, ["a"]))))
+    prob = tensorize([cpu_pod()], cat, [a, b])
+    pools = {o.pool for o in prob.options}
+    assert pools == {"a", "b"}
+
+
+def test_pad_to_buckets():
+    assert pad_to(1) == 256
+    assert pad_to(257) == 1024
+    assert pad_to(70000) == 131072
