@@ -1,0 +1,109 @@
+"""Benchmark harness — the BASELINE.json configs on real hardware.
+
+Headline (north star): schedule 50k pending pods × 600 instance types in
+<200ms on TPU v5e-1.  The reference has no published numbers (BASELINE.md);
+its scale tests bound the same shapes at minutes-scale wall clock on real
+clusters.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": 200/p50}
+(vs_baseline > 1 == beating the 200ms target).  Per-config details go to
+stderr."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_pods(spec_count, total, rng, gpu_frac=0.0, zone_frac=0.0,
+               taint_frac=0.0, selector_zones=("zone-a", "zone-b", "zone-c")):
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.objects import Pod
+    from karpenter_tpu.api.resources import CPU, GPU, MEMORY, ResourceList
+    from karpenter_tpu.api.taints import Toleration
+
+    specs = []
+    for i in range(spec_count):
+        cpu = int(rng.integers(100, 8000))
+        mem = int(rng.integers(128, 32768)) * 2**20
+        req = ResourceList({CPU: cpu, MEMORY: mem})
+        sel = {}
+        tol = []
+        if rng.random() < gpu_frac:
+            req[GPU] = int(rng.choice([1, 2, 4, 8]))
+        if rng.random() < zone_frac:
+            sel[wk.ZONE] = str(rng.choice(list(selector_zones)))
+        if rng.random() < taint_frac:
+            tol = [Toleration("dedicated", "Exists")]
+        specs.append((req, sel, tol))
+    per = total // spec_count
+    extra = total - per * spec_count
+    pods = []
+    for i, (req, sel, tol) in enumerate(specs):
+        n = per + (1 if i < extra else 0)
+        pods.extend(Pod(requests=ResourceList(req), node_selector=dict(sel),
+                        tolerations=list(tol)) for _ in range(n))
+    return pods
+
+
+def time_solve(prob, iters=5):
+    from karpenter_tpu.ops.classpack import solve_classpack
+    solve_classpack(prob, decode=False)           # compile + warm
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = solve_classpack(prob, decode=False)
+        times.append((time.perf_counter() - t0) * 1000)
+    return float(np.median(times)), r
+
+
+def run_config(name, pods, n_types, pools=None, iters=5):
+    from karpenter_tpu.api.objects import NodePool
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.ops.tensorize import tensorize
+
+    catalog = generate_catalog(n_types)
+    t0 = time.perf_counter()
+    prob = tensorize(pods, catalog, pools or [NodePool()])
+    t_tensorize = (time.perf_counter() - t0) * 1000
+    p50, r = time_solve(prob, iters)
+    log(f"[{name}] pods={len(pods)} types={n_types} classes={prob.num_classes} "
+        f"options={prob.num_options} tensorize={t_tensorize:.0f}ms "
+        f"solve_p50={p50:.1f}ms nodes={len(r.nodes)} "
+        f"cost=${r.total_price:.2f}/h unsched={len(r.unschedulable)}")
+    return p50, t_tensorize
+
+
+def main():
+    import jax
+    log("devices:", jax.devices())
+    rng = np.random.default_rng(42)
+
+    # config 1: 1k homogeneous CPU pods, 10 types
+    run_config("1k-homogeneous", build_pods(1, 1000, rng), 10, iters=3)
+    # config 2: 10k mixed pods, 200 types
+    run_config("10k-mixed", build_pods(100, 10_000, rng, zone_frac=0.3), 200, iters=3)
+    # config 3: 5k GPU pods
+    run_config("5k-gpu", build_pods(40, 5_000, rng, gpu_frac=1.0), 600, iters=3)
+    # config 5 (headline): 50k burst, 600 types, constraints + spot/od pricing
+    headline_pods = build_pods(200, 50_000, rng, gpu_frac=0.05, zone_frac=0.2,
+                               taint_frac=0.1)
+    p50, t_tensorize = run_config("50k-burst", headline_pods, 600, iters=5)
+
+    baseline_ms = 200.0
+    print(json.dumps({
+        "metric": "50k-pod x 600-type scheduling solve p50 latency",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / p50, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from .sharded import (SHARD_AXIS, make_pod_mesh, solve_sharded, split_counts)

@@ -1,0 +1,127 @@
+"""Multi-chip sharding of the assignment problem.
+
+The reference scales by concurrency inside one Go process (batcher worker
+pools, informer fan-outs — SURVEY.md §2.2 parallelism note); the TPU-native
+scale axis is a `jax.sharding.Mesh`.  The decomposition:
+
+  * **pod-batch ("data") sharding** — each device packs a disjoint slice of
+    every pod class (counts are split across the mesh), a valid bin-packing
+    decomposition because bins never span pods from two shards;
+  * **capacity accounting via collectives** — per-option node counts, total
+    cost, and unscheduled counts are `psum`'d over the mesh, giving the
+    global launch plan and letting NodePool-limit checks see the whole fleet;
+  * the option axis (catalog) is replicated: at ~3600 columns × 8 resources
+    it is KiB-scale, so replication beats an all-to-all every time; a future
+    option-sharded scoring stage would ride the same mesh axis.
+
+This module is exercised single-host over N virtual devices (tests) and by
+the driver's `dryrun_multichip`; the same code runs unchanged on a real
+multi-chip mesh because only `jax.make_mesh` changes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.classpack import class_pack_aggregate_kernel
+from ..ops.tensorize import Problem, pad_to
+
+SHARD_AXIS = "pods"
+
+
+def make_pod_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n}-device mesh but only {len(devs)} "
+                         f"devices are available")
+    return Mesh(np.asarray(devs[:n]), (SHARD_AXIS,))
+
+
+def split_counts(counts: np.ndarray, n_shards: int) -> np.ndarray:
+    """Split per-class pod counts across shards: n_shards×C. Remainders
+    rotate with the class index so no shard becomes a systematic straggler
+    (the scan is lockstep — wall clock is the heaviest shard)."""
+    C = len(counts)
+    base = counts // n_shards
+    rem = counts - base * n_shards
+    out = np.tile(base, (n_shards, 1))
+    # shard s takes one extra pod of class c iff (s - c) mod n < rem[c]
+    rot = (np.arange(n_shards)[:, None] - np.arange(C)[None, :]) % n_shards
+    out += (rot < rem[None, :]).astype(counts.dtype)
+    return out
+
+
+@partial(jax.jit, static_argnames=("max_nodes_per_shard", "mesh"))
+def _sharded_pack(requests, counts_sharded, compat, alloc, price, rank,
+                  max_nodes_per_shard: int, mesh: Mesh):
+    """shard_map'd pack: every device scans its pod slice, then the launch
+    plan is psum-aggregated over the mesh."""
+    O = alloc.shape[0]
+
+    def shard_fn(counts_local):
+        counts_local = counts_local[0]        # drop the unit shard dim
+        K = max_nodes_per_shard
+        # mark per-shard state as mesh-varying (each device packs its own bins)
+        init_option = jax.lax.pcast(jnp.full((K,), -1, jnp.int32),
+                                    (SHARD_AXIS,), to='varying')
+        init_used = jax.lax.pcast(
+            jnp.zeros((K, requests.shape[1]), jnp.int32),
+            (SHARD_AXIS,), to='varying')
+        # same guarded reduction as the single-chip aggregate path —
+        # flat = [cost, n_open, n_unsched, nodes_per_option…]
+        flat = class_pack_aggregate_kernel(
+            requests, counts_local, compat, alloc, price, rank,
+            init_option, init_used, K)
+        # ICI collective: the global launch plan every host can act on
+        return jax.lax.psum(flat, SHARD_AXIS)[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SHARD_AXIS),),
+        out_specs=P(SHARD_AXIS))
+    flat = fn(counts_sharded)[0]
+    return flat[0], flat[3:3 + O].astype(jnp.int32), flat[2].astype(jnp.int32)
+
+
+def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
+                  max_nodes_per_shard: int = 4096):
+    """Pack a Problem over a device mesh. Returns
+    (total_cost, nodes_per_option O int array, unscheduled count)."""
+    mesh = mesh or make_pod_mesh()
+    n = mesh.devices.size
+    order = problem.class_order()
+    C = problem.num_classes
+    Cpad = pad_to(C, (64, 256, 1024, 4096))
+    R = len(problem.axes)
+    O = problem.num_options
+    Opad = pad_to(O, (512, 2048, 8192))
+
+    requests = np.zeros((Cpad, R), np.int32)
+    requests[:C] = problem.class_requests[order].astype(np.int32)
+    compat = np.zeros((Cpad, Opad), bool)
+    compat[:C, :O] = problem.class_compat[order]
+    alloc = np.zeros((Opad, R), np.int32)
+    alloc[:O] = problem.option_alloc.astype(np.int32)
+    price = np.full(Opad, np.inf, np.float32)
+    price[:O] = problem.option_price
+    rank = np.full(Opad, 2**30 - 1, np.int32)
+    rank[:O] = problem.option_rank
+
+    counts_sharded = np.zeros((n, Cpad), np.int32)
+    counts_sharded[:, :C] = split_counts(
+        problem.class_counts[order].astype(np.int32), n)
+
+    cost, nodes_per_option, unsched = _sharded_pack(
+        jnp.asarray(requests), jnp.asarray(counts_sharded), jnp.asarray(compat),
+        jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank),
+        max_nodes_per_shard, mesh)
+    cost, nodes_per_option, unsched = jax.device_get(
+        (cost, nodes_per_option, unsched))
+    return float(cost), np.asarray(nodes_per_option)[:O], int(unsched)

@@ -1,9 +1,19 @@
 """Test harness: force an 8-device virtual CPU platform so multi-chip
-sharding paths run without TPU hardware (the driver's dryrun does the same)."""
+sharding paths run without TPU hardware (the driver's dryrun does the same).
+
+jax is already imported by pytest plugins (jaxtyping) before this conftest
+runs, and jax snapshots JAX_PLATFORMS at import — so configure via
+jax.config, not os.environ."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# for any subprocesses tests spawn
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
