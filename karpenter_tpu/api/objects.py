@@ -14,6 +14,7 @@ these (karpenter_tpu.ops.tensorize), never on the objects themselves.
 from __future__ import annotations
 
 import itertools
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
@@ -92,6 +93,44 @@ class Pod:
     @property
     def do_not_disrupt(self) -> bool:
         return self.annotations.get(self.DO_NOT_DISRUPT, "") == "true"
+
+
+@dataclass
+class PodDisruptionBudget:
+    """Voluntary-disruption budget over a pod label selector — the blocker the
+    reference's consolidation and termination flows honor
+    (/root/reference/designs/consolidation.md:44-52, eviction API drain at
+    /root/reference/website/content/en/docs/concepts/disruption.md:27-35).
+    `min_available` / `max_unavailable` accept an absolute int or "N%"."""
+    name: str = ""
+    namespace: str = "default"
+    selector: Dict[str, str] = field(default_factory=dict)
+    min_available: Optional[object] = None
+    max_unavailable: Optional[object] = None
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = _uid("pdb")
+
+    def matches(self, pod: "Pod") -> bool:
+        return (pod.namespace == self.namespace
+                and all(pod.labels.get(k) == v for k, v in self.selector.items()))
+
+    @staticmethod
+    def _resolve(value, total: int) -> int:
+        if isinstance(value, str) and value.endswith("%"):
+            return math.ceil(total * float(value[:-1]) / 100.0)
+        return int(value)
+
+    def allowed_disruptions(self, matching_healthy: int, matching_total: int) -> int:
+        """How many more matching pods may be voluntarily evicted right now."""
+        if self.min_available is not None:
+            floor = self._resolve(self.min_available, matching_total)
+            return max(0, matching_healthy - floor)
+        if self.max_unavailable is not None:
+            cap = self._resolve(self.max_unavailable, matching_total)
+            return max(0, cap - (matching_total - matching_healthy))
+        return max(0, matching_healthy)  # no constraint
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,453 @@
+"""Disruption controller: consolidation, emptiness, expiration, drift.
+
+Re-implements karpenter-core's disruption (née deprovisioning) engine as
+reconstructed in SURVEY.md §2.2 from the reference's in-tree design docs:
+
+  * candidate discovery with blockers — do-not-disrupt pods, PDB budgets,
+    ownerless pods, recently-created nodes, in-flight nominations
+    (/root/reference/designs/consolidation.md:44-52);
+  * method ordering expiration → drift → emptiness → consolidation, ONE
+    action executed per reconcile tick
+    (/root/reference/designs/deprovisioning.md:11-31);
+  * consolidation's two actions: node *deletion* (pods fit on the remaining
+    nodes) and node *replacement* (pods fit on remaining nodes + one cheaper
+    node), decided by simulated scheduling
+    (/root/reference/designs/consolidation.md:7-21);
+  * disruption-cost candidate ranking weighted by remaining node lifetime
+    (/root/reference/designs/consolidation.md:25-42);
+  * the `karpenter.sh/disruption:NoSchedule` taint, replacement pre-spin,
+    and rollback on failed launches
+    (/root/reference/website/content/en/docs/concepts/disruption.md:9-35).
+
+TPU-first re-design: where the reference replays its object-graph scheduler
+once per candidate, the simulation here is the same batched packing kernel
+used for provisioning — a candidate's pods + the surviving nodes' dense
+slots + a price-masked option set — so multi-node consolidation evaluates a
+whole candidate prefix in one solve (SURVEY.md §7.6).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as wk
+from ..api.objects import Node, NodeClaim, NodePool, Pod
+from ..api.resources import ResourceList
+from ..api.taints import NO_SCHEDULE, Taint
+from ..catalog.instancetype import InstanceType
+from ..cloud.provider import CloudProvider, InsufficientCapacityError
+from ..ops.classpack import solve_classpack
+from ..ops.ffd import PackingResult
+from ..ops.tensorize import Problem, tensorize
+from ..state.cluster import Cluster
+
+log = logging.getLogger("karpenter_tpu.disruption")
+
+DISRUPTION_TAINT = Taint(wk.DISRUPTION_TAINT_KEY, NO_SCHEDULE, "disrupting")
+
+# Tunables (/root/reference/designs/consolidation.md:61-67,
+# /root/reference/designs/deprovisioning.md:27-33).
+DEFAULT_STABILIZATION_S = 5 * 60.0   # min node lifetime before disruption
+
+
+@dataclass
+class Candidate:
+    node: Node
+    claim: Optional[NodeClaim]
+    pool: NodePool
+    reschedulable: List[Pod]
+    disruption_cost: float
+    price: float
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+@dataclass
+class Action:
+    """One disruption decision: delete `candidates`, optionally launching
+    `replacements` first (named {delete,replace}{Consolidation,Emptiness,
+    Expiration,Drift} like the reference's action strings,
+    /root/reference/designs/deprovisioning.md:11-31)."""
+    kind: str                       # "delete" | "replace"
+    reason: str                     # "consolidation" | "emptiness" | ...
+    candidates: List[Candidate]
+    simulation: Optional[PackingResult] = None
+    problem: Optional[Problem] = None
+    surviving_nodes: List[Node] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return f"{self.kind}/{self.reason}"
+
+
+@dataclass
+class DisruptionResult:
+    action: Optional[Action] = None
+    launched: List[NodeClaim] = field(default_factory=list)
+    deleted: List[str] = field(default_factory=list)
+    error: str = ""
+
+
+def pod_disruption_cost(pod: Pod) -> float:
+    """Per-pod eviction cost: more pods, higher priority, and explicit
+    pod-deletion-cost all make a node more expensive to disrupt
+    (/root/reference/designs/consolidation.md:25-42)."""
+    return 1.0 + max(pod.priority, 0) / 1e4 + pod.deletion_cost / 1e3
+
+
+def node_disruption_cost(node: Node, pool: NodePool, now: float) -> float:
+    cost = sum(pod_disruption_cost(p) for p in node.pods)
+    expire = pool.disruption.expire_after_s
+    if expire:
+        # nodes close to expiry are cheap to disrupt (lifetime weighting)
+        remaining = max(0.0, 1.0 - (now - node.created_at) / expire)
+        cost *= remaining
+    return cost
+
+
+def _is_daemon(pod: Pod) -> bool:
+    return pod.owner_kind == "DaemonSet"
+
+
+class DisruptionController:
+    """Single-action disruption loop over cluster state."""
+
+    def __init__(self, provider: CloudProvider, cluster: Cluster,
+                 nodepools: Sequence[NodePool],
+                 clock: Callable[[], float] = time.time,
+                 stabilization_s: float = DEFAULT_STABILIZATION_S,
+                 drift_enabled: bool = True,
+                 max_candidates: int = 64):
+        self.provider = provider
+        self.cluster = cluster
+        self.nodepools = {p.name: p for p in nodepools}
+        self.clock = clock
+        self.stabilization_s = stabilization_s
+        self.drift_enabled = drift_enabled
+        self.max_candidates = max_candidates
+        self._empty_since: Dict[str, float] = {}  # node → first seen empty
+
+    # ------------------------------------------------------------------
+    # candidate discovery
+    # ------------------------------------------------------------------
+    def candidates(self) -> List[Candidate]:
+        """Disruptable nodes, cheapest disruption first. Blockers per
+        /root/reference/designs/consolidation.md:44-52."""
+        now = self.clock()
+        budgets = self.cluster.pdb_budgets()
+        out: List[Candidate] = []
+        for node in self.cluster.nodes.values():
+            pool = self.nodepools.get(node.nodepool)
+            if pool is None or node.marked_for_deletion:
+                continue
+            if now - node.created_at < self.stabilization_s:
+                continue  # min node lifetime
+            if node.nominated_until > now:
+                continue  # in-flight pod nomination
+            blocked = False
+            for p in node.pods:
+                if p.do_not_disrupt or (not p.owner_kind and not _is_daemon(p)):
+                    blocked = True
+                    break
+            if blocked:
+                continue
+            resched = [p for p in node.pods if not _is_daemon(p)]
+            if not self.cluster.evictable(resched, budgets):
+                continue  # PDB budget exhausted
+            claim = next((c for c in self.cluster.nodeclaims.values()
+                          if c.provider_id == node.provider_id), None)
+            out.append(Candidate(
+                node=node, claim=claim, pool=pool, reschedulable=resched,
+                disruption_cost=node_disruption_cost(node, pool, now),
+                price=node.price))
+        out.sort(key=lambda c: (c.disruption_cost, c.name))
+        return out[:self.max_candidates]
+
+    # ------------------------------------------------------------------
+    # simulation: the scheduler re-used as the consolidation simulator
+    # ------------------------------------------------------------------
+    def _filtered_catalog(self, max_total_price: Optional[float]) -> List[InstanceType]:
+        """Launch options for replacement simulations. `max_total_price`
+        strictly bounds offering price — replacement must be cheaper
+        (/root/reference/designs/consolidation.md:15-21)."""
+        catalog = self.provider.get_instance_types()
+        if max_total_price is None:
+            return catalog
+        out = []
+        for it in catalog:
+            offerings = [o for o in it.offerings
+                         if o.available and o.price < max_total_price]
+            if offerings:
+                out.append(InstanceType(
+                    name=it.name, requirements=it.requirements,
+                    offerings=offerings, capacity=it.capacity,
+                    kube_reserved=it.kube_reserved,
+                    system_reserved=it.system_reserved,
+                    eviction_threshold=it.eviction_threshold, info=it.info))
+        return out
+
+    def simulate(self, excluded: Sequence[Candidate],
+                 allow_new: bool = False,
+                 max_total_price: Optional[float] = None
+                 ) -> Tuple[Problem, PackingResult, List[Node]]:
+        """Would the excluded candidates' pods schedule on the surviving
+        nodes [+ cheaper new capacity]?  One batched solve over dense arrays
+        (SURVEY.md §7.6) instead of the reference's per-candidate replay."""
+        pods = [p for c in excluded for p in c.reschedulable]
+        catalog = self._filtered_catalog(max_total_price) if allow_new else []
+        pools = list(self.nodepools.values())
+        problem = tensorize(pods, catalog, pools)
+        exclude_names = [c.name for c in excluded]
+        node_list, alloc, used, compat = self.cluster.tensorize_nodes(
+            problem.class_reps, problem.axes, exclude=exclude_names)
+        if len(node_list) == 0 and problem.num_options == 0:
+            result = PackingResult(
+                nodes=[], unschedulable=list(range(len(pods))),
+                existing_assignments={}, total_price=0.0)
+            return problem, result, node_list
+        result = solve_classpack(
+            problem,
+            existing_alloc=alloc if len(node_list) else None,
+            existing_used=used if len(node_list) else None,
+            existing_compat=compat if len(node_list) else None)
+        return problem, result, node_list
+
+    # ------------------------------------------------------------------
+    # methods, in reference order
+    # ------------------------------------------------------------------
+    def find_expired(self, cands: List[Candidate]) -> List[Candidate]:
+        now = self.clock()
+        return [c for c in cands
+                if c.pool.disruption.expire_after_s
+                and now - c.node.created_at > c.pool.disruption.expire_after_s]
+
+    def find_drifted(self, cands: List[Candidate]) -> List[Candidate]:
+        if not self.drift_enabled:
+            return []
+        out = []
+        for c in cands:
+            if c.claim is not None and self.provider.is_drifted(c.claim, c.pool):
+                out.append(c)
+        return out
+
+    def find_empty(self, cands: List[Candidate]) -> List[Candidate]:
+        """Emptiness: nodes with no reschedulable pods that have STAYED empty
+        for consolidate_after_s (time-since-empty, not node age — a node that
+        just lost its last pod gets the full delay)."""
+        now = self.clock()
+        empty_names = set()
+        out = []
+        for c in cands:
+            if c.reschedulable:
+                continue
+            empty_names.add(c.name)
+            since = self._empty_since.setdefault(c.name, now)
+            after = c.pool.disruption.consolidate_after_s or 0.0
+            if now - since < after:
+                continue
+            out.append(c)
+        # nodes that regained pods (or vanished) reset their empty timer
+        for name in list(self._empty_since):
+            if name not in empty_names:
+                del self._empty_since[name]
+        return out
+
+    # ------------------------------------------------------------------
+    # the single-action reconcile
+    # ------------------------------------------------------------------
+    def reconcile(self) -> DisruptionResult:
+        cands = self.candidates()
+        if not cands:
+            return DisruptionResult()
+
+        # 1. expiration (graceful replace: pods rescheduled, new capacity allowed)
+        expired = self.find_expired(cands)
+        if expired:
+            action = self._replace_or_delete(expired[:1], "expiration")
+            if action:
+                return self.execute(action)
+
+        # 2. drift
+        drifted = self.find_drifted(cands)
+        if drifted:
+            action = self._replace_or_delete(drifted[:1], "drift")
+            if action:
+                return self.execute(action)
+
+        # 3. emptiness — all empty candidates in one shot (reference's
+        #    emptiness batch delete)
+        empty = self.find_empty(cands)
+        if empty:
+            return self.execute(Action(kind="delete", reason="emptiness",
+                                       candidates=empty))
+
+        # 4. consolidation (WhenUnderutilized pools only)
+        underutil = [c for c in cands
+                     if c.pool.disruption.consolidation_policy == "WhenUnderutilized"]
+        action = self.consolidation_action(underutil)
+        if action:
+            return self.execute(action)
+        return DisruptionResult()
+
+    def _replace_or_delete(self, targets: List[Candidate], reason: str) -> Optional[Action]:
+        """Expiration/drift disruption: pods must land somewhere — on the
+        surviving nodes or on replacement capacity at any price."""
+        problem, result, survivors = self.simulate(targets, allow_new=True)
+        if result.unschedulable:
+            log.info("%s of %s blocked: %d pods would be unschedulable",
+                     reason, [c.name for c in targets], len(result.unschedulable))
+            return None
+        kind = "replace" if result.nodes else "delete"
+        return Action(kind=kind, reason=reason, candidates=targets,
+                      simulation=result, problem=problem,
+                      surviving_nodes=survivors)
+
+    def consolidation_action(self, cands: List[Candidate]) -> Optional[Action]:
+        """Multi-node delete first (largest feasible prefix of the
+        cost-sorted candidates, binary search like the reference's
+        multi-node consolidation), then single-node delete-or-replace."""
+        cands = [c for c in cands if self._consolidatable(c)]
+        if not cands:
+            return None
+
+        # multi-node / single-node DELETE: pods fit on surviving nodes alone.
+        # The union of a subset's evictions must clear the PDB budgets too —
+        # per-node checks in candidates() don't compose.
+        lo, hi, best = 1, len(cands), None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            subset = cands[:mid]
+            union = [p for c in subset for p in c.reschedulable]
+            if not self.cluster.evictable(union):
+                hi = mid - 1
+                continue
+            problem, result, survivors = self.simulate(subset, allow_new=False)
+            if not result.unschedulable and not result.nodes:
+                best = Action(kind="delete", reason="consolidation",
+                              candidates=subset, simulation=result,
+                              problem=problem, surviving_nodes=survivors)
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        if best is not None:
+            return best
+
+        # single-node pass (non-prefix candidates the binary search missed):
+        # DELETE if the solver lands every pod on survivors, else REPLACE
+        # with ONE strictly-cheaper node
+        for c in cands:
+            if not c.reschedulable:
+                continue
+            problem, result, survivors = self.simulate(
+                [c], allow_new=True, max_total_price=c.price)
+            if result.unschedulable or len(result.nodes) > 1:
+                continue
+            if not result.nodes:   # pure delete — survivors absorb everything
+                return Action(kind="delete", reason="consolidation",
+                              candidates=[c], simulation=result,
+                              problem=problem, surviving_nodes=survivors)
+            if result.total_price >= c.price:
+                continue
+            # spot→spot replacement needs flexibility: require the cheaper
+            # node to have alternatives (reference requires ≥15 cheaper
+            # offerings for spot; we require >1 as the fake catalog is small)
+            if (c.node.capacity_type == wk.CAPACITY_TYPE_SPOT
+                    and result.nodes[0].option.capacity_type == wk.CAPACITY_TYPE_SPOT
+                    and len(result.nodes[0].alternatives) <= 1):
+                continue
+            return Action(kind="replace", reason="consolidation",
+                          candidates=[c], simulation=result, problem=problem,
+                          surviving_nodes=survivors)
+        return None
+
+    def _consolidatable(self, c: Candidate) -> bool:
+        now = self.clock()
+        after = c.pool.disruption.consolidate_after_s
+        if after is not None and now - c.node.created_at < after:
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    # execution: taint → pre-spin replacements → rebind → terminate
+    # ------------------------------------------------------------------
+    def execute(self, action: Action) -> DisruptionResult:
+        out = DisruptionResult(action=action)
+        # taint first so nothing new schedules onto doomed nodes
+        # (website/.../concepts/disruption.md:9-14)
+        for c in action.candidates:
+            c.node.marked_for_deletion = True
+            if DISRUPTION_TAINT not in c.node.taints:
+                c.node.taints.append(DISRUPTION_TAINT)
+
+        new_nodes: List[Node] = []
+        catalog_by_name = {it.name: it for it in self.provider.get_instance_types()}
+        if action.simulation is not None and action.simulation.nodes:
+            from .provisioning import claim_from_decision
+            for decision in action.simulation.nodes:
+                dpods = [action.problem.pods[i] for i in decision.pod_indices]
+                claim = claim_from_decision(decision, dpods, self.nodepools)
+                try:
+                    claim = self.provider.create(claim)
+                except InsufficientCapacityError as e:
+                    # rollback: untaint, unmark, abandon the action
+                    # (website/.../concepts/disruption.md:12-14)
+                    log.warning("disruption rollback, launch failed: %s", e)
+                    self._rollback(action, new_nodes, out)
+                    out.error = str(e)
+                    return out
+                it = catalog_by_name.get(claim.instance_type)
+                node = self.cluster.register_nodeclaim(
+                    claim, it.allocatable if it else claim.requests,
+                    it.capacity if it else None)
+                node._decision = decision
+                new_nodes.append(node)
+                out.launched.append(claim)
+
+        # rebind evicted pods per the simulation's placement
+        if action.simulation is not None:
+            sim = action.simulation
+            for pod_i, slot in sim.existing_assignments.items():
+                self.cluster.bind_pod(action.problem.pods[pod_i],
+                                      action.surviving_nodes[slot].name)
+            for node in new_nodes:
+                for pod_i in node._decision.pod_indices:
+                    self.cluster.bind_pod(action.problem.pods[pod_i], node.name)
+
+        # terminate candidates (drain semantics live in the termination
+        # controller; state-level effect is identical)
+        for c in action.candidates:
+            # daemonset pods die with their node — they must NOT be requeued
+            # as pending (a fresh node would be provisioned just for them)
+            for p in list(c.node.pods):
+                if _is_daemon(p):
+                    self.cluster.delete_pod(p)
+            try:
+                if c.claim is not None:
+                    self.provider.delete(c.claim)
+                    self.cluster.nodeclaims.pop(c.claim.name, None)
+                self.cluster.remove_node(c.name)
+                out.deleted.append(c.name)
+            except Exception as e:  # noqa: BLE001 - cloud errors surface in result
+                out.error = str(e)
+        log.info("disruption %s: deleted %s, launched %s", action.name,
+                 out.deleted, [c.name for c in out.launched])
+        return out
+
+    def _rollback(self, action: Action, new_nodes: List[Node],
+                  out: DisruptionResult):
+        for c in action.candidates:
+            c.node.marked_for_deletion = False
+            c.node.taints = [t for t in c.node.taints if t != DISRUPTION_TAINT]
+        for node in new_nodes:
+            claim = next((cl for cl in self.cluster.nodeclaims.values()
+                          if cl.provider_id == node.provider_id), None)
+            if claim is not None:
+                self.provider.delete(claim)
+                self.cluster.nodeclaims.pop(claim.name, None)
+            self.cluster.remove_node(node.name)
+        out.launched.clear()

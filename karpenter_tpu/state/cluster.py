@@ -19,12 +19,16 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..api import labels as wk
-from ..api.objects import Node, NodeClaim, Pod
+from ..api.objects import Node, NodeClaim, Pod, PodDisruptionBudget
 from ..api.requirements import Requirements
 from ..api.resources import DEFAULT_AXES, DEFAULT_SCALES, PODS, ResourceList
 from ..api.taints import tolerates_all
 
 _names = itertools.count(1)
+
+# How long a fresh node stays protected from disruption while its pods are
+# still in flight (the reference's nomination window in state.Cluster).
+NOMINATION_WINDOW_S = 20.0
 
 
 class Cluster:
@@ -33,6 +37,7 @@ class Cluster:
         self.nodes: Dict[str, Node] = {}
         self.nodeclaims: Dict[str, NodeClaim] = {}
         self.pods: Dict[str, Pod] = {}          # uid -> pod (all known pods)
+        self.pdbs: Dict[str, PodDisruptionBudget] = {}
 
     # ---- pods ----
     def add_pod(self, pod: Pod) -> Pod:
@@ -53,7 +58,9 @@ class Cluster:
             old = self.nodes[pod.node_name]
             old.pods = [p for p in old.pods if p.uid != pod.uid]
         pod.node_name = node_name
-        self.nodes[node_name].pods.append(pod)
+        node = self.nodes[node_name]
+        node.pods.append(pod)
+        node.nominated_until = 0.0  # nomination fulfilled
 
     def unbind_pod(self, pod: Pod):
         if pod.node_name and pod.node_name in self.nodes:
@@ -101,6 +108,9 @@ class Cluster:
             capacity_type=claim.capacity_type,
             price=claim.price,
             created_at=self.clock(),
+            # protected from disruption until its pods bind (or the window
+            # lapses) — the reference's in-flight nomination blocker
+            nominated_until=self.clock() + NOMINATION_WINDOW_S,
         )
         node.labels.setdefault(wk.HOSTNAME, node.name)
         return self.add_node(node)
@@ -119,6 +129,42 @@ class Cluster:
             if n.nodepool:
                 out[n.nodepool] = out.get(n.nodepool, ResourceList()) + n.capacity
         return out
+
+    # ---- PDBs / eviction safety ----
+    def add_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        self.pdbs[pdb.name] = pdb
+        return pdb
+
+    def remove_pdb(self, name: str):
+        self.pdbs.pop(name, None)
+
+    def pdb_budget(self, pdb: PodDisruptionBudget) -> int:
+        """Remaining voluntary evictions the budget allows right now. Bound
+        pods count as healthy; pending ones as unavailable."""
+        matching = [p for p in self.pods.values() if pdb.matches(p)]
+        healthy = sum(1 for p in matching if p.node_name)
+        return pdb.allowed_disruptions(healthy, len(matching))
+
+    def pdb_budgets(self) -> Dict[str, int]:
+        """All budgets in one pass — candidates() precomputes this so the
+        per-node evictable() checks don't rescan the pod set."""
+        return {name: self.pdb_budget(pdb) for name, pdb in self.pdbs.items()}
+
+    def evictable(self, pods: Sequence[Pod],
+                  budgets: Optional[Dict[str, int]] = None) -> bool:
+        """Would evicting ALL of `pods` at once violate any PDB? The blocker
+        the consolidation candidate filter and the drain flow share
+        (/root/reference/designs/consolidation.md:44-52)."""
+        if not self.pdbs:
+            return True
+        draw: Dict[str, int] = {}
+        for p in pods:
+            for pdb in self.pdbs.values():
+                if pdb.matches(p):
+                    draw[pdb.name] = draw.get(pdb.name, 0) + 1
+        if budgets is None:
+            budgets = self.pdb_budgets()
+        return all(budgets[name] >= n for name, n in draw.items())
 
     # ---- tensorization of live capacity ----
     def tensorize_nodes(self, pod_classes: Sequence[Pod],

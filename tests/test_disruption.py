@@ -1,0 +1,417 @@
+"""Disruption controller behavior: consolidation delete/replace, emptiness,
+expiration, drift, blockers, and rollback.
+
+Mirrors the reference's disruption semantics reconstructed in SURVEY.md §2.2
+(/root/reference/designs/consolidation.md, designs/deprovisioning.md,
+website/content/en/docs/concepts/disruption.md)."""
+
+import pytest
+
+from helpers import cpu_pod, make_type, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (Disruption, NodePool, Pod,
+                                       PodDisruptionBudget)
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.controllers.disruption import (DISRUPTION_TAINT,
+                                                  DisruptionController)
+from karpenter_tpu.state import Cluster
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+
+
+def env(catalog=None, pools=None, stabilization=0.0):
+    clock = FakeClock()
+    cloud = FakeCloud(clock)
+    provider = CloudProvider(cloud, catalog or small_catalog(), clock=clock)
+    cluster = Cluster(clock)
+    pools = pools or [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized"))]
+    prov = Provisioner(provider, cluster, pools, clock=clock)
+    ctrl = DisruptionController(provider, cluster, pools, clock=clock,
+                                stabilization_s=stabilization)
+    return clock, cloud, provider, cluster, prov, ctrl
+
+
+def provision(cluster, prov, pods):
+    cluster.add_pods(pods)
+    res = prov.provision()
+    assert not res.unschedulable
+    return res
+
+
+# ---------------------------------------------------------------------------
+# emptiness
+# ---------------------------------------------------------------------------
+
+def test_empty_node_deleted_when_empty_policy():
+    pools = [NodePool(disruption=Disruption(consolidation_policy="WhenEmpty",
+                                            consolidate_after_s=30))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    provision(cluster, prov, [cpu_pod()])
+    node = next(iter(cluster.nodes.values()))
+    for p in list(node.pods):
+        cluster.delete_pod(p)
+    # too young: consolidate_after not elapsed
+    res = ctrl.reconcile()
+    assert res.action is None
+    clock.step(60)
+    res = ctrl.reconcile()
+    assert res.action is not None and res.action.reason == "emptiness"
+    assert res.deleted == [node.name]
+    assert not cluster.nodes
+    assert not cloud.running()
+
+
+def test_all_empty_nodes_deleted_in_one_action():
+    pools = [NodePool(disruption=Disruption(consolidation_policy="WhenEmpty",
+                                            consolidate_after_s=0))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    for _ in range(3):
+        provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3500)])
+    assert len(cluster.nodes) == 3
+    for p in list(cluster.pods.values()):
+        cluster.delete_pod(p)
+    res = ctrl.reconcile()
+    assert len(res.deleted) == 3
+
+
+# ---------------------------------------------------------------------------
+# consolidation
+# ---------------------------------------------------------------------------
+
+def test_consolidation_delete_packs_pods_onto_survivor():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    # two nodes, each lightly loaded; pods of both fit on either one
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3000)])
+    assert len(cluster.nodes) == 2
+    res = ctrl.reconcile()
+    assert res.action is not None
+    assert res.action.name == "delete/consolidation"
+    assert len(cluster.nodes) == 1
+    survivor = next(iter(cluster.nodes.values()))
+    assert len(survivor.pods) == 2          # evicted pod rebound
+    assert not cluster.pending_pods()
+    assert len(cloud.running()) == 1
+
+
+def test_consolidation_replace_with_cheaper_node():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    # force a big node via a big pod + a tiny pod, then delete the big pod:
+    # the tiny remainder justifies replacing xlarge with small
+    big = cpu_pod(cpu_m=12000, mem_mib=24000)
+    tiny = cpu_pod(cpu_m=200, mem_mib=256)
+    provision(cluster, prov, [big, tiny])
+    node = next(iter(cluster.nodes.values()))
+    assert node.instance_type == "a.xlarge"
+    cluster.delete_pod(big)
+    res = ctrl.reconcile()
+    assert res.action is not None and res.action.name == "replace/consolidation"
+    assert len(res.launched) == 1
+    assert len(cluster.nodes) == 1
+    new = next(iter(cluster.nodes.values()))
+    assert new.instance_type == "a.small"
+    assert new.price < node.price
+    assert [p.uid for p in new.pods] == [tiny.uid]
+
+
+def test_multinode_consolidation_binary_search():
+    zones = ("zone-a", "zone-b", "zone-c", "zone-d")
+    catalog = [make_type("a.small", 2, 4, 0.10, zones=zones)]
+    clock, cloud, provider, cluster, prov, ctrl = env(catalog=catalog)
+    # 4 nodes (forced apart by per-zone selectors), then swap the big pods
+    # for unconstrained tiny ones so every node is nearly empty
+    bigs = [cpu_pod(cpu_m=1500, mem_mib=2000, node_selector={wk.ZONE: z})
+            for z in zones]
+    provision(cluster, prov, bigs)
+    assert len(cluster.nodes) == 4
+    for b in bigs:
+        cluster.delete_pod(b)
+    for node in cluster.nodes.values():
+        tiny = cpu_pod(cpu_m=100, mem_mib=128)
+        cluster.add_pod(tiny)
+        cluster.bind_pod(tiny, node.name)
+    res = ctrl.reconcile()
+    assert res.action is not None and res.action.kind == "delete"
+    # largest feasible prefix deleted: 3 of 4 (pods fit on the last survivor)
+    assert len(res.deleted) == 3
+    assert len(cluster.nodes) == 1
+    assert len(next(iter(cluster.nodes.values())).pods) == 4
+
+
+def test_consolidation_noop_when_packed():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    # one node fully utilized — nothing to consolidate
+    provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3500)])
+    res = ctrl.reconcile()
+    assert res.action is None
+    assert len(cluster.nodes) == 1
+
+
+def test_consolidate_after_defers_consolidation():
+    pools = [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized", consolidate_after_s=120))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3000)])
+    assert len(cluster.nodes) == 2
+    assert ctrl.reconcile().action is None      # within consolidate_after
+    clock.step(180)
+    assert ctrl.reconcile().action is not None
+
+
+# ---------------------------------------------------------------------------
+# blockers
+# ---------------------------------------------------------------------------
+
+def test_do_not_disrupt_pod_blocks():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    blocked = cpu_pod(cpu_m=400,
+                      annotations={Pod.DO_NOT_DISRUPT: "true"})
+    provision(cluster, prov, [blocked])
+    provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3000)])
+    res = ctrl.reconcile()
+    # only the unblocked node is a candidate; its pods fit on the blocked
+    # node's leftover capacity? no — blocked node is a.small (2cpu, 400m
+    # used): 1800m does not fit. so no action.
+    names = [c.name for c in ctrl.candidates()]
+    assert blocked.node_name not in names
+
+
+def test_ownerless_pod_blocks():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    naked = cpu_pod(cpu_m=400, owner_kind="")
+    provision(cluster, prov, [naked])
+    assert ctrl.candidates() == []
+
+
+def test_pdb_blocks_consolidation():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    pod = cpu_pod(cpu_m=400, labels={"app": "web"})
+    provision(cluster, prov, [pod])
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    cluster.add_pdb(PodDisruptionBudget(selector={"app": "web"},
+                                        min_available=1))
+    names = [c.name for c in ctrl.candidates()]
+    assert pod.node_name not in names
+
+
+def test_stabilization_window_blocks_young_nodes():
+    clock, cloud, provider, cluster, prov, ctrl = env(stabilization=300)
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    assert ctrl.candidates() == []
+    clock.step(600)
+    assert len(ctrl.candidates()) == 1
+
+
+def test_daemonset_pods_dont_block_and_dont_reschedule():
+    pools = [NodePool(disruption=Disruption(consolidation_policy="WhenEmpty",
+                                            consolidate_after_s=0))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    app = cpu_pod(cpu_m=400)
+    provision(cluster, prov, [app])
+    node = next(iter(cluster.nodes.values()))
+    ds = cpu_pod(cpu_m=50, owner_kind="DaemonSet")
+    cluster.add_pod(ds)
+    cluster.bind_pod(ds, node.name)
+    cluster.delete_pod(app)
+    res = ctrl.reconcile()     # daemonset-only node counts as empty
+    assert res.action is not None and res.action.reason == "emptiness"
+
+
+def test_pdb_union_blocks_multinode_consolidation():
+    """Per-node PDB checks don't compose: a budget of 1 must stop a
+    multi-node delete that would evict 2 matching pods at once."""
+    zones = ("zone-a", "zone-b", "zone-c")
+    catalog = [make_type("a.small", 2, 4, 0.10, zones=zones),
+               make_type("a.large", 8, 16, 0.40, zones=zones)]
+    clock, cloud, provider, cluster, prov, ctrl = env(catalog=catalog)
+    # big empty-ish landing node + two nodes with one web pod each
+    anchor = cpu_pod(cpu_m=6000, mem_mib=8000)
+    provision(cluster, prov, [anchor])
+    web = [cpu_pod(cpu_m=1500, mem_mib=2000, labels={"app": "web"},
+                   node_selector={wk.ZONE: z}) for z in ("zone-b", "zone-c")]
+    provision(cluster, prov, web)
+    assert len(cluster.nodes) == 3
+    cluster.add_pdb(PodDisruptionBudget(selector={"app": "web"},
+                                        max_unavailable=1))
+    res = ctrl.reconcile()
+    # at most ONE web pod may be evicted per action
+    if res.action is not None:
+        evicted = [p for c in res.action.candidates for p in c.reschedulable
+                   if p.labels.get("app") == "web"]
+        assert len(evicted) <= 1
+
+
+def test_daemonset_pods_die_with_node():
+    pools = [NodePool(disruption=Disruption(consolidation_policy="WhenEmpty",
+                                            consolidate_after_s=0))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    app = cpu_pod(cpu_m=400)
+    provision(cluster, prov, [app])
+    node = next(iter(cluster.nodes.values()))
+    ds = cpu_pod(cpu_m=50, owner_kind="DaemonSet")
+    cluster.add_pod(ds)
+    cluster.bind_pod(ds, node.name)
+    cluster.delete_pod(app)
+    res = ctrl.reconcile()
+    assert res.deleted == [node.name]
+    # the daemonset pod must NOT be requeued as pending — no ghost node
+    # provisioned for it next tick
+    assert not cluster.pending_pods()
+    assert prov.provision().launched == []
+
+
+def test_empty_timer_runs_from_became_empty_not_node_age():
+    pools = [NodePool(disruption=Disruption(consolidation_policy="WhenEmpty",
+                                            consolidate_after_s=30))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    pod = cpu_pod(cpu_m=400)
+    provision(cluster, prov, [pod])
+    node = next(iter(cluster.nodes.values()))
+    clock.step(3600)                      # node is old…
+    cluster.delete_pod(pod)               # …but became empty just now
+    assert ctrl.reconcile().action is None
+    clock.step(10)
+    assert ctrl.reconcile().action is None
+    clock.step(30)
+    res = ctrl.reconcile()
+    assert res.deleted == [node.name]
+
+
+def test_fresh_node_nomination_blocks_disruption():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    pod = cpu_pod(cpu_m=400)
+    provision(cluster, prov, [pod])
+    node = next(iter(cluster.nodes.values()))
+    # binding fulfilled the nomination
+    assert node.nominated_until == 0.0
+    # an unbound fresh node stays protected
+    from karpenter_tpu.api.objects import NodeClaim
+    from karpenter_tpu.api.resources import ResourceList as RL
+    claim = next(iter(cluster.nodeclaims.values()))
+    empty_claim = NodeClaim(nodepool=claim.nodepool, labels=dict(claim.labels))
+    empty_claim.price = 0.1
+    n2 = cluster.register_nodeclaim(empty_claim, node.allocatable, node.capacity)
+    assert n2.nominated_until > clock()
+    names = [c.name for c in ctrl.candidates()]
+    assert n2.name not in names
+    clock.step(60)                        # window lapses → fair game
+    names = [c.name for c in ctrl.candidates()]
+    assert n2.name in names
+
+
+# ---------------------------------------------------------------------------
+# expiration + drift
+# ---------------------------------------------------------------------------
+
+def test_expiration_replaces_node():
+    pools = [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized", expire_after_s=3600))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    pod = cpu_pod(cpu_m=1800, mem_mib=3000)
+    provision(cluster, prov, [pod])
+    old = next(iter(cluster.nodes.values()))
+    assert ctrl.find_expired(ctrl.candidates()) == []
+    clock.step(7200)
+    res = ctrl.reconcile()
+    assert res.action is not None and res.action.reason == "expiration"
+    assert old.name in res.deleted
+    assert len(cluster.nodes) == 1
+    new = next(iter(cluster.nodes.values()))
+    assert new.name != old.name
+    assert [p.uid for p in new.pods] == [pod.uid]
+    assert len(cloud.running()) == 1
+
+
+def test_drift_on_catalog_removal():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    claim = next(iter(cluster.nodeclaims.values()))
+    # remove the launched type from the catalog → claim drifts
+    provider.instance_types.base_catalog = [
+        t for t in provider.instance_types.base_catalog
+        if t.name != claim.instance_type]
+    provider.instance_types._memo = None
+    res = ctrl.reconcile()
+    assert res.action is not None and res.action.reason == "drift"
+    assert len(cluster.nodes) == 1
+    assert next(iter(cluster.nodes.values())).instance_type != claim.instance_type
+
+
+def test_drift_disabled_feature_gate():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    ctrl.drift_enabled = False
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    claim = next(iter(cluster.nodeclaims.values()))
+    provider.instance_types.base_catalog = [
+        t for t in provider.instance_types.base_catalog
+        if t.name != claim.instance_type]
+    provider.instance_types._memo = None
+    assert ctrl.find_drifted(ctrl.candidates()) == []
+
+
+# ---------------------------------------------------------------------------
+# rollback
+# ---------------------------------------------------------------------------
+
+def test_rollback_on_failed_replacement_launch():
+    pools = [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized", expire_after_s=3600))]
+    clock, cloud, provider, cluster, prov, ctrl = env(pools=pools)
+    pod = cpu_pod(cpu_m=1800, mem_mib=3000)
+    provision(cluster, prov, [pod])
+    node = next(iter(cluster.nodes.values()))
+    clock.step(7200)
+    # ICE every offering so the replacement launch fails
+    for t in provider.instance_types.base_catalog:
+        for o in t.offerings:
+            cloud.insufficient_capacity_pools.add((o.capacity_type, t.name, o.zone))
+    res = ctrl.reconcile()
+    assert res.error
+    assert res.launched == [] and res.deleted == []
+    # node untainted, unmarked, pod still bound
+    assert not node.marked_for_deletion
+    assert DISRUPTION_TAINT not in node.taints
+    assert pod.node_name == node.name
+    assert len(cluster.nodes) == 1
+
+
+def test_disruption_taint_applied_during_execution():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3000)])
+    res = ctrl.reconcile()
+    assert res.action is not None
+    # deleted node was marked and tainted before removal
+    for c in res.action.candidates:
+        assert c.node.marked_for_deletion
+        assert DISRUPTION_TAINT in c.node.taints
+
+
+# ---------------------------------------------------------------------------
+# disruption cost ranking
+# ---------------------------------------------------------------------------
+
+def test_candidates_ranked_by_disruption_cost():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    light = cpu_pod(cpu_m=100)
+    provision(cluster, prov, [light])
+    # force the heavy pods onto a separate node via a zone selector
+    heavy = [cpu_pod(cpu_m=100, priority=1000,
+                     node_selector={wk.ZONE: "zone-b"}) for _ in range(3)]
+    provision(cluster, prov, heavy)
+    cands = ctrl.candidates()
+    assert len(cands) == 2
+    assert cands[0].name == light.node_name   # fewer/lower-priority pods first
