@@ -94,6 +94,12 @@ class Pod:
     def do_not_disrupt(self) -> bool:
         return self.annotations.get(self.DO_NOT_DISRUPT, "") == "true"
 
+    @property
+    def is_daemon(self) -> bool:
+        """DaemonSet pods are not reschedulable: they die with their node
+        and never block or justify capacity decisions."""
+        return self.owner_kind == "DaemonSet"
+
 
 @dataclass
 class PodDisruptionBudget:

@@ -65,7 +65,8 @@ class FakeCloud:
     """The cloud API the provider talks to. Thread-safe; failure injection via
     `insufficient_capacity_pools` and `next_error`."""
 
-    def __init__(self, clock: Callable[[], float] = time.time):
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 queue: Optional["FakeQueue"] = None):
         self.clock = clock
         self._lock = threading.RLock()
         self._instances: Dict[str, CloudInstance] = {}
@@ -74,6 +75,7 @@ class FakeCloud:
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
         self.next_error: Optional[Exception] = None
         self.calls: Dict[str, int] = {}
+        self.queue = queue  # interruption events published here when attached
 
     # ---- test knobs ----
     def reset(self):
@@ -171,13 +173,37 @@ class FakeCloud:
             inst.tags.update(tags)
 
     # ---- chaos helpers ----
+    def _publish(self, kind: str, ids, state: str = ""):
+        if self.queue is not None:
+            from .queue import make_event_body
+            self.queue.send(make_event_body(kind, ids, state=state,
+                                            ts=self.clock()))
+
     def interrupt(self, iid: str) -> CloudInstance:
-        """Spot-interrupt an instance (terminates it; the interruption
-        controller learns via the event queue)."""
+        """Spot-interrupt an instance. With a queue attached this publishes
+        the 2-minute warning and leaves the capacity up for the controller
+        to drain; without one there is nobody to warn, so the capacity is
+        reclaimed immediately (pre-queue behavior)."""
         with self._lock:
-            inst = self._instances[iid]
-            inst.state = "terminated"
-            return inst
+            inst = self._instances.get(iid)
+            if inst is None:
+                raise CloudError("InstanceNotFound", iid)
+            if self.queue is None:
+                inst.state = "terminated"
+                return inst
+        from .queue import SPOT_INTERRUPTION
+        self._publish(SPOT_INTERRUPTION, [iid])
+        return inst
+
+    def reclaim(self, iid: str) -> None:
+        """The interruption deadline passed: capacity is pulled and a
+        state-change event fires."""
+        with self._lock:
+            inst = self._instances.get(iid)
+            if inst is not None:
+                inst.state = "terminated"
+        from .queue import STATE_CHANGE
+        self._publish(STATE_CHANGE, [iid], state="terminated")
 
     def running(self) -> List[CloudInstance]:
         return self.describe_instances()

@@ -1,1 +1,6 @@
 from .provisioning import Provisioner, ProvisioningResult, claim_from_decision
+from .disruption import DisruptionController, DisruptionResult
+from .termination import TerminationController, TerminationResult
+from .interruption import InterruptionController, InterruptionResult
+from .garbagecollection import (GarbageCollectionController, GCResult,
+                                TaggingController)

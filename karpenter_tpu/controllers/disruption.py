@@ -38,6 +38,7 @@ from ..api.objects import Node, NodeClaim, NodePool, Pod
 from ..api.resources import ResourceList
 from ..api.taints import NO_SCHEDULE, Taint
 from ..catalog.instancetype import InstanceType
+from ..cloud.fake import CloudError
 from ..cloud.provider import CloudProvider, InsufficientCapacityError
 from ..ops.classpack import solve_classpack
 from ..ops.ffd import PackingResult
@@ -110,10 +111,6 @@ def node_disruption_cost(node: Node, pool: NodePool, now: float) -> float:
     return cost
 
 
-def _is_daemon(pod: Pod) -> bool:
-    return pod.owner_kind == "DaemonSet"
-
-
 class DisruptionController:
     """Single-action disruption loop over cluster state."""
 
@@ -122,11 +119,13 @@ class DisruptionController:
                  clock: Callable[[], float] = time.time,
                  stabilization_s: float = DEFAULT_STABILIZATION_S,
                  drift_enabled: bool = True,
-                 max_candidates: int = 64):
+                 max_candidates: int = 64,
+                 terminator: Optional["TerminationController"] = None):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = {p.name: p for p in nodepools}
         self.clock = clock
+        self.terminator = terminator
         self.stabilization_s = stabilization_s
         self.drift_enabled = drift_enabled
         self.max_candidates = max_candidates
@@ -151,16 +150,15 @@ class DisruptionController:
                 continue  # in-flight pod nomination
             blocked = False
             for p in node.pods:
-                if p.do_not_disrupt or (not p.owner_kind and not _is_daemon(p)):
+                if p.do_not_disrupt or (not p.owner_kind and not p.is_daemon):
                     blocked = True
                     break
             if blocked:
                 continue
-            resched = [p for p in node.pods if not _is_daemon(p)]
+            resched = [p for p in node.pods if not p.is_daemon]
             if not self.cluster.evictable(resched, budgets):
                 continue  # PDB budget exhausted
-            claim = next((c for c in self.cluster.nodeclaims.values()
-                          if c.provider_id == node.provider_id), None)
+            claim = self.cluster.claim_for_provider_id(node.provider_id)
             out.append(Candidate(
                 node=node, claim=claim, pool=pool, reschedulable=resched,
                 disruption_cost=node_disruption_cost(node, pool, now),
@@ -418,22 +416,37 @@ class DisruptionController:
                 for pod_i in node._decision.pod_indices:
                     self.cluster.bind_pod(action.problem.pods[pod_i], node.name)
 
-        # terminate candidates (drain semantics live in the termination
-        # controller; state-level effect is identical)
+        # terminate candidates — through the finalizer-drain flow when a
+        # terminator is wired, else the inline state-level equivalent
         for c in action.candidates:
+            if self.terminator is not None:
+                tres = self.terminator.drain_sync(c.node, reason=action.reason)
+                out.deleted.extend(tres.terminated)
+                if tres.errors:
+                    out.error = "; ".join(tres.errors)
+                continue
             # daemonset pods die with their node — they must NOT be requeued
             # as pending (a fresh node would be provisioned just for them)
             for p in list(c.node.pods):
-                if _is_daemon(p):
+                if p.is_daemon:
                     self.cluster.delete_pod(p)
             try:
                 if c.claim is not None:
                     self.provider.delete(c.claim)
                     self.cluster.nodeclaims.pop(c.claim.name, None)
-                self.cluster.remove_node(c.name)
-                out.deleted.append(c.name)
-            except Exception as e:  # noqa: BLE001 - cloud errors surface in result
-                out.error = str(e)
+            except CloudError as e:
+                if e.code != "InstanceNotFound":  # already gone == success
+                    # transient cloud failure: untaint so the next reconcile
+                    # retries this (now-empty) node instead of stranding a
+                    # billed zombie behind marked_for_deletion
+                    c.node.marked_for_deletion = False
+                    c.node.taints = [t for t in c.node.taints
+                                     if t.key != DISRUPTION_TAINT.key]
+                    out.error = str(e)
+                    continue
+                self.cluster.nodeclaims.pop(c.claim.name, None)
+            self.cluster.remove_node(c.name)
+            out.deleted.append(c.name)
         log.info("disruption %s: deleted %s, launched %s", action.name,
                  out.deleted, [c.name for c in out.launched])
         return out
@@ -442,10 +455,10 @@ class DisruptionController:
                   out: DisruptionResult):
         for c in action.candidates:
             c.node.marked_for_deletion = False
-            c.node.taints = [t for t in c.node.taints if t != DISRUPTION_TAINT]
+            c.node.taints = [t for t in c.node.taints
+                             if t.key != DISRUPTION_TAINT.key]
         for node in new_nodes:
-            claim = next((cl for cl in self.cluster.nodeclaims.values()
-                          if cl.provider_id == node.provider_id), None)
+            claim = self.cluster.claim_for_provider_id(node.provider_id)
             if claim is not None:
                 self.provider.delete(claim)
                 self.cluster.nodeclaims.pop(claim.name, None)

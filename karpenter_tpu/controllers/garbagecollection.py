@@ -1,0 +1,106 @@
+"""Garbage collection: leaked cloud capacity and orphaned node objects.
+
+Re-implements the reference's nodeclaim GC
+(/root/reference/pkg/controllers/nodeclaim/garbagecollection/controller.go:57-115):
+list all cluster-owned cloud instances, terminate any running longer than
+the registration grace period with no matching NodeClaim (a "leak" — e.g. a
+crash between CreateFleet and claim persistence), and delete Node objects
+whose backing instance is gone.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List
+
+from ..cloud.provider import CloudProvider
+from ..state.cluster import Cluster
+
+log = logging.getLogger("karpenter_tpu.gc")
+
+# Instances younger than this may simply not have registered yet
+# (reference: 30s, garbagecollection/controller.go:94-115).
+REGISTRATION_GRACE_S = 30.0
+
+
+@dataclass
+class GCResult:
+    leaked_instances: List[str] = field(default_factory=list)
+    orphaned_nodes: List[str] = field(default_factory=list)
+
+
+class GarbageCollectionController:
+    """Singleton sweep comparing cloud ground truth with cluster state."""
+
+    def __init__(self, provider: CloudProvider, cluster: Cluster,
+                 clock: Callable[[], float] = time.time,
+                 grace_s: float = REGISTRATION_GRACE_S):
+        self.provider = provider
+        self.cluster = cluster
+        self.clock = clock
+        self.grace_s = grace_s
+
+    def reconcile(self) -> GCResult:
+        out = GCResult()
+        now = self.clock()
+        known_ids = {c.provider_id for c in self.cluster.nodeclaims.values()
+                     if c.provider_id}
+        cloud_claims = self.provider.list()
+        cloud_ids = {c.provider_id for c in cloud_claims}
+
+        # leaked instances: cloud capacity nobody claims past the grace period
+        for claim in cloud_claims:
+            if claim.provider_id in known_ids:
+                continue
+            if now - claim.launched_at < self.grace_s:
+                continue
+            try:
+                self.provider.delete(claim)
+            except Exception:  # noqa: BLE001 — already-gone is success
+                pass
+            node = self.cluster.node_for_provider_id(claim.provider_id)
+            if node is not None:
+                self.cluster.remove_node(node.name)
+            out.leaked_instances.append(claim.provider_id)
+            log.info("GC: terminated leaked instance %s", claim.provider_id)
+
+        # orphaned nodes: node object outlived its instance (e.g. reclaimed
+        # spot capacity) — evict state so pods requeue
+        for node in list(self.cluster.nodes.values()):
+            if node.provider_id and node.provider_id not in cloud_ids:
+                claim = self.cluster.claim_for_provider_id(node.provider_id)
+                if claim is not None:
+                    self.cluster.nodeclaims.pop(claim.name, None)
+                self.cluster.remove_node(node.name)
+                out.orphaned_nodes.append(node.name)
+                log.info("GC: removed orphaned node %s", node.name)
+        return out
+
+
+class TaggingController:
+    """Post-registration instance tagging
+    (/root/reference/pkg/controllers/nodeclaim/tagging/controller.go):
+    stamps the node name onto the backing instance once it registers."""
+
+    NODE_NAME_TAG = "karpenter.sh/node-name"
+
+    def __init__(self, provider: CloudProvider, cluster: Cluster):
+        self.provider = provider
+        self.cluster = cluster
+
+    def reconcile(self) -> List[str]:
+        tagged = []
+        for node in self.cluster.nodes.values():
+            if not node.provider_id:
+                continue
+            try:
+                inst = self.provider.cloud.get_instance(node.provider_id)
+            except Exception:  # noqa: BLE001 — instance gone; GC's problem
+                continue
+            if inst.tags.get(self.NODE_NAME_TAG) != node.name:
+                self.provider.cloud.create_tags(
+                    node.provider_id, {self.NODE_NAME_TAG: node.name})
+                tagged.append(node.provider_id)
+        return tagged

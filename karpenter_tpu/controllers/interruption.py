@@ -1,0 +1,146 @@
+"""Interruption controller: queue events → offering blacklist + node recycle.
+
+Re-implements the reference's interruption loop
+(/root/reference/pkg/controllers/interruption/controller.go:82-121):
+receive ≤10 messages, parse via the kind registry
+(parser.go:54-80), map instance-id → node/claim, then
+
+  * spot-interruption → mark the offering unavailable (spot ICE,
+    controller.go:194-200) AND terminate the node (cordon & drain,
+    handleNodeClaim controller.go:181-205);
+  * scheduled-change / state-change(stopping|terminated) → terminate;
+  * rebalance-recommendation → event only, no action (reference default);
+  * noop / unmatched instances → just delete the message.
+
+Messages are deleted only after successful handling, so failures retry on
+the next receive (SQS visibility semantics in cloud/queue.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import labels as wk
+from ..api.objects import Node, NodeClaim
+from ..cloud.provider import CloudProvider
+from ..cloud.queue import (FakeQueue, Message, NOOP, ParsedEvent,
+                           REBALANCE_RECOMMENDATION, SCHEDULED_CHANGE,
+                           SPOT_INTERRUPTION, STATE_CHANGE, parse_event)
+from ..state.cluster import Cluster
+from .termination import TerminationController
+
+log = logging.getLogger("karpenter_tpu.interruption")
+
+# state-change states that mean the instance is going/gone
+_DEAD_STATES = {"stopping", "stopped", "shutting-down", "terminated"}
+
+
+@dataclass
+class InterruptionResult:
+    received: int = 0
+    handled: Dict[str, int] = field(default_factory=dict)   # kind → count
+    recycled: List[str] = field(default_factory=list)       # node names
+    deleted_messages: int = 0
+
+    def bump(self, kind: str):
+        self.handled[kind] = self.handled.get(kind, 0) + 1
+
+
+class InterruptionController:
+    """Singleton poll loop over the interruption queue."""
+
+    def __init__(self, queue: FakeQueue, provider: CloudProvider,
+                 cluster: Cluster, terminator: TerminationController,
+                 clock: Callable[[], float] = time.time):
+        self.queue = queue
+        self.provider = provider
+        self.cluster = cluster
+        self.terminator = terminator
+        self.clock = clock
+
+    # ------------------------------------------------------------------
+    def reconcile(self, max_batches: int = 1) -> InterruptionResult:
+        out = InterruptionResult()
+        # visibility timeout: messages whose handling failed last tick are
+        # redelivered now so stalled drains (PDBs) eventually complete
+        self.queue.release_inflight()
+        for _ in range(max_batches):
+            messages = self.queue.receive()
+            if not messages:
+                break
+            out.received += len(messages)
+            # instance-id → (node, claim) map built once per batch
+            # (makeNodeClaimInstanceIDMap, controller.go:94-101)
+            by_id = self._instance_map()
+            for msg in messages:
+                event = parse_event(msg.body)
+                if self._handle(event, by_id, out):
+                    self.queue.delete(msg.receipt)
+                    out.deleted_messages += 1
+        return out
+
+    def _instance_map(self) -> Dict[str, Tuple[Optional[Node], Optional[NodeClaim]]]:
+        out: Dict[str, Tuple[Optional[Node], Optional[NodeClaim]]] = {}
+        for claim in self.cluster.nodeclaims.values():
+            if claim.provider_id:
+                out[claim.provider_id] = (None, claim)
+        for node in self.cluster.nodes.values():
+            if node.provider_id:
+                claim = out.get(node.provider_id, (None, None))[1]
+                out[node.provider_id] = (node, claim)
+        return out
+
+    # ------------------------------------------------------------------
+    def _handle(self, event: ParsedEvent, by_id, out: InterruptionResult) -> bool:
+        """Returns True when the message is fully handled (safe to delete)."""
+        out.bump(event.kind)
+        if event.kind == NOOP:
+            return True
+        ok = True
+        for iid in event.instance_ids:
+            node, claim = by_id.get(iid, (None, None))
+            if node is None and claim is None:
+                continue  # not ours / already gone
+            if event.kind == SPOT_INTERRUPTION:
+                self._mark_spot_unavailable(node, claim)
+            if event.kind == REBALANCE_RECOMMENDATION:
+                continue  # observability only, no action (reference default)
+            if event.kind == STATE_CHANGE and \
+                    event.detail.get("state", "") not in _DEAD_STATES:
+                continue
+            ok = self._recycle(node, claim, event.kind, out) and ok
+        return ok
+
+    def _mark_spot_unavailable(self, node: Optional[Node],
+                               claim: Optional[NodeClaim]) -> None:
+        """An interrupted spot offering is exhausted capacity: blacklist it
+        so the next solve avoids relaunching into the same pool
+        (controller.go:194-200)."""
+        src = node or claim
+        if src is None or src.capacity_type != wk.CAPACITY_TYPE_SPOT:
+            return
+        if src.instance_type and src.zone:
+            self.provider.unavailable.mark_unavailable(
+                "interruption", src.instance_type, src.zone, src.capacity_type)
+
+    def _recycle(self, node: Optional[Node], claim: Optional[NodeClaim],
+                 reason: str, out: InterruptionResult) -> bool:
+        """Cordon & drain through the termination flow; evicted pods go
+        pending and the provisioner replaces the capacity."""
+        if node is not None:
+            res = self.terminator.drain_sync(node, reason=reason)
+            if node.name in res.terminated:
+                out.recycled.append(node.name)
+                return True
+            return False  # drain stalled (PDBs) — retry via redelivery
+        # claim without a node (instance never registered): delete directly
+        if claim is not None:
+            try:
+                self.provider.delete(claim)
+            except Exception:  # noqa: BLE001 — vanished instance is success
+                pass
+            self.cluster.nodeclaims.pop(claim.name, None)
+        return True

@@ -121,6 +121,12 @@ class Cluster:
                 return n
         return None
 
+    def claim_for_provider_id(self, provider_id: str) -> Optional[NodeClaim]:
+        for c in self.nodeclaims.values():
+            if c.provider_id == provider_id:
+                return c
+        return None
+
     def nodepool_usage(self) -> Dict[str, ResourceList]:
         """Capacity in use per NodePool — feeds limits enforcement
         (/root/reference/designs/limits.md)."""

@@ -388,6 +388,27 @@ def test_rollback_on_failed_replacement_launch():
     assert len(cluster.nodes) == 1
 
 
+def test_transient_delete_failure_untaints_for_retry():
+    """A cloud error during inline termination must not strand a tainted
+    zombie node — the node is unmarked so the next reconcile retries."""
+    from karpenter_tpu.cloud.fake import CloudError
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    provision(cluster, prov, [cpu_pod(cpu_m=400)])
+    provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3000)])
+    cloud.next_error = CloudError("InternalError", "transient")
+    res = ctrl.reconcile()
+    assert res.action is not None
+    assert res.error and res.deleted == []
+    doomed = res.action.candidates[0].node
+    assert not doomed.marked_for_deletion
+    assert DISRUPTION_TAINT not in doomed.taints
+    assert len(cloud.running()) == 2          # instance still billed, visible
+    # next tick retries and succeeds (node now empty → trivially deletable)
+    res2 = ctrl.reconcile()
+    assert res2.deleted == [doomed.name]
+    assert len(cloud.running()) == 1
+
+
 def test_disruption_taint_applied_during_execution():
     clock, cloud, provider, cluster, prov, ctrl = env()
     provision(cluster, prov, [cpu_pod(cpu_m=400)])

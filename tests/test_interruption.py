@@ -1,0 +1,237 @@
+"""Interruption path: queue events → ICE blacklist + node recycle
+(/root/reference/pkg/controllers/interruption/controller.go:82-205), plus
+garbage collection and tagging
+(/root/reference/pkg/controllers/nodeclaim/garbagecollection/controller.go)."""
+
+import pytest
+
+from helpers import cpu_pod, make_type
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool, NodePoolTemplate, Pod, Requirements
+from karpenter_tpu.api.requirements import IN, Requirement
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.cloud.queue import (FakeQueue, NOOP, SCHEDULED_CHANGE,
+                                       SPOT_INTERRUPTION, STATE_CHANGE,
+                                       make_event_body, parse_event)
+from karpenter_tpu.controllers import (GarbageCollectionController,
+                                       InterruptionController, Provisioner,
+                                       TaggingController,
+                                       TerminationController)
+from karpenter_tpu.state import Cluster
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+
+
+def spot_catalog():
+    return [make_type("a.small", 2, 4, 0.10, spot_discount=0.7),
+            make_type("a.medium", 4, 8, 0.20, spot_discount=0.7)]
+
+
+def env(pools=None):
+    clock = FakeClock()
+    queue = FakeQueue(clock)
+    cloud = FakeCloud(clock, queue=queue)
+    provider = CloudProvider(cloud, spot_catalog(), clock=clock)
+    cluster = Cluster(clock)
+    pools = pools or [NodePool()]
+    prov = Provisioner(provider, cluster, pools, clock=clock)
+    term = TerminationController(provider, cluster, clock=clock)
+    intr = InterruptionController(queue, provider, cluster, term, clock=clock)
+    return clock, queue, cloud, provider, cluster, prov, term, intr
+
+
+# ---------------------------------------------------------------------------
+# parser registry
+# ---------------------------------------------------------------------------
+
+def test_parse_roundtrip_all_kinds():
+    for kind, ids in [(SPOT_INTERRUPTION, ["i-1"]),
+                      (SCHEDULED_CHANGE, ["i-1", "i-2"]),
+                      (STATE_CHANGE, ["i-3"])]:
+        ev = parse_event(make_event_body(kind, ids))
+        assert ev.kind == kind
+        assert ev.instance_ids == ids
+
+
+def test_parse_garbage_is_noop():
+    assert parse_event("not json").kind == NOOP
+    assert parse_event('{"detail-type": "Something Else"}').kind == NOOP
+
+
+# ---------------------------------------------------------------------------
+# spot interruption → ICE + recycle
+# ---------------------------------------------------------------------------
+
+def test_spot_interruption_recycles_node_and_marks_ice():
+    clock, queue, cloud, provider, cluster, prov, term, intr = env()
+    pod = cpu_pod(cpu_m=400)
+    cluster.add_pod(pod)
+    res = prov.provision()
+    claim = res.launched[0]
+    assert claim.capacity_type == wk.CAPACITY_TYPE_SPOT  # spot is cheaper
+    node_name = pod.node_name
+
+    cloud.interrupt(claim.provider_id)
+    assert len(queue) == 1
+    ires = intr.reconcile()
+    assert ires.received == 1
+    assert ires.recycled == [node_name]
+    assert ires.deleted_messages == 1
+    # offering blacklisted so the replacement avoids the doomed pool
+    assert provider.unavailable.is_unavailable(
+        wk.CAPACITY_TYPE_SPOT, claim.instance_type, claim.zone)
+    # pod requeued; replacement provisioning avoids the ICE'd offering
+    assert cluster.pending_pods() == [pod]
+    r2 = prov.provision()
+    assert len(r2.launched) == 1
+    new = r2.launched[0]
+    assert (new.instance_type, new.zone, new.capacity_type) != \
+        (claim.instance_type, claim.zone, claim.capacity_type)
+
+
+def test_on_demand_interruption_no_ice_marking():
+    pools = [NodePool(template=NodePoolTemplate(requirements=Requirements.of(
+        Requirement(wk.CAPACITY_TYPE, IN, [wk.CAPACITY_TYPE_ON_DEMAND]))))]
+    clock, queue, cloud, provider, cluster, prov, term, intr = env(pools)
+    cluster.add_pod(cpu_pod(cpu_m=400))
+    res = prov.provision()
+    claim = res.launched[0]
+    assert claim.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+    cloud.interrupt(claim.provider_id)
+    intr.reconcile()
+    assert provider.unavailable.seq_num == 0  # nothing blacklisted
+
+
+def test_state_change_terminated_recycles():
+    clock, queue, cloud, provider, cluster, prov, term, intr = env()
+    pod = cpu_pod(cpu_m=400)
+    cluster.add_pod(pod)
+    res = prov.provision()
+    claim = res.launched[0]
+    cloud.reclaim(claim.provider_id)         # hard state-change event
+    ires = intr.reconcile()
+    assert ires.handled.get(STATE_CHANGE) == 1
+    assert len(ires.recycled) == 1
+    assert not cluster.nodes
+    assert cluster.pending_pods() == [pod]
+
+
+def test_running_state_change_is_ignored():
+    clock, queue, cloud, provider, cluster, prov, term, intr = env()
+    cluster.add_pod(cpu_pod(cpu_m=400))
+    res = prov.provision()
+    claim = res.launched[0]
+    queue.send(make_event_body(STATE_CHANGE, [claim.provider_id],
+                               state="running"))
+    ires = intr.reconcile()
+    assert ires.recycled == []
+    assert len(cluster.nodes) == 1
+
+
+def test_unknown_instance_message_deleted():
+    clock, queue, cloud, provider, cluster, prov, term, intr = env()
+    queue.send(make_event_body(SPOT_INTERRUPTION, ["i-doesnotexist"]))
+    ires = intr.reconcile()
+    assert ires.deleted_messages == 1
+    assert len(queue) == 0
+
+
+def test_batch_cap_and_multiple_batches():
+    clock, queue, cloud, provider, cluster, prov, term, intr = env()
+    for i in range(25):
+        queue.send(make_event_body(SPOT_INTERRUPTION, [f"i-{i}"]))
+    r1 = intr.reconcile(max_batches=1)
+    assert r1.received == 10                  # SQS receive cap
+    r2 = intr.reconcile(max_batches=5)
+    assert r2.received == 15
+
+
+def test_stalled_drain_retries_via_redelivery():
+    """A PDB-blocked drain must not drop the interruption: the undeleted
+    message is redelivered and handled once the budget frees."""
+    clock, queue, cloud, provider, cluster, prov, term, intr = env()
+    web = [cpu_pod(cpu_m=300, labels={"app": "web"}) for _ in range(2)]
+    cluster.add_pods(web)
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    from karpenter_tpu.api.objects import PodDisruptionBudget
+    cluster.add_pdb(PodDisruptionBudget(selector={"app": "web"},
+                                        min_available=1))
+    claim = next(iter(cluster.nodeclaims.values()))
+    cloud.interrupt(claim.provider_id)
+    r1 = intr.reconcile()
+    assert r1.recycled == [] and r1.deleted_messages == 0  # stalled on PDB
+    # one pod was evicted; once it reschedules the budget frees
+    prov.provision()
+    r2 = intr.reconcile()                    # redelivered message
+    assert r2.received == 1
+    assert len(r2.recycled) == 1
+    assert len(queue) == 0
+
+
+# ---------------------------------------------------------------------------
+# garbage collection + tagging
+# ---------------------------------------------------------------------------
+
+def gc_env():
+    clock, queue, cloud, provider, cluster, prov, term, intr = env()
+    gc = GarbageCollectionController(provider, cluster, clock=clock)
+    return clock, cloud, provider, cluster, prov, gc
+
+
+def test_gc_terminates_leaked_instance_after_grace():
+    clock, cloud, provider, cluster, prov, gc = gc_env()
+    # leak: launch directly against the cloud, no NodeClaim
+    from karpenter_tpu.cloud.fake import FleetOverride
+    cloud.create_fleet([FleetOverride("a.small", "zone-a", "spot", 0.03)],
+                       tags={"karpenter.sh/cluster": "default"})
+    assert gc.reconcile().leaked_instances == []   # inside grace period
+    clock.step(60)
+    res = gc.reconcile()
+    assert len(res.leaked_instances) == 1
+    assert not cloud.running()
+
+
+def test_gc_ignores_foreign_instances():
+    clock, cloud, provider, cluster, prov, gc = gc_env()
+    from karpenter_tpu.cloud.fake import FleetOverride
+    cloud.create_fleet([FleetOverride("a.small", "zone-a", "spot", 0.03)],
+                       tags={"karpenter.sh/cluster": "SOMEONE-ELSE"})
+    clock.step(60)
+    assert gc.reconcile().leaked_instances == []
+    assert len(cloud.running()) == 1
+
+
+def test_gc_removes_orphaned_node_and_requeues_pods():
+    clock, cloud, provider, cluster, prov, gc = gc_env()
+    pod = cpu_pod(cpu_m=400)
+    cluster.add_pod(pod)
+    res = prov.provision()
+    claim = res.launched[0]
+    # instance dies without any event (e.g. dropped message)
+    cloud.terminate_instances([claim.provider_id])
+    out = gc.reconcile()
+    assert len(out.orphaned_nodes) == 1
+    assert not cluster.nodes
+    assert cluster.pending_pods() == [pod]
+
+
+def test_tagging_controller_stamps_node_name():
+    clock, cloud, provider, cluster, prov, gc = gc_env()
+    cluster.add_pod(cpu_pod(cpu_m=400))
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    tagger = TaggingController(provider, cluster)
+    assert tagger.reconcile() == [node.provider_id]
+    inst = cloud.get_instance(node.provider_id)
+    assert inst.tags[TaggingController.NODE_NAME_TAG] == node.name
+    assert tagger.reconcile() == []            # idempotent

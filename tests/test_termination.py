@@ -1,0 +1,148 @@
+"""Termination controller: finalizer → taint → PDB-respecting drain →
+instance delete (reference flow at
+/root/reference/website/content/en/docs/concepts/disruption.md:27-35)."""
+
+import pytest
+
+from helpers import cpu_pod, small_catalog
+from karpenter_tpu.api.objects import NodePool, PodDisruptionBudget
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import (DisruptionController, Provisioner,
+                                       TerminationController)
+from karpenter_tpu.controllers.termination import TERMINATION_TAINT
+from karpenter_tpu.state import Cluster
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+
+
+def env():
+    clock = FakeClock()
+    cloud = FakeCloud(clock)
+    provider = CloudProvider(cloud, small_catalog(), clock=clock)
+    cluster = Cluster(clock)
+    pools = [NodePool()]
+    prov = Provisioner(provider, cluster, pools, clock=clock)
+    term = TerminationController(provider, cluster, clock=clock)
+    return clock, cloud, provider, cluster, prov, term
+
+
+def test_terminate_empty_node():
+    clock, cloud, provider, cluster, prov, term = env()
+    pod = cpu_pod(cpu_m=400)
+    cluster.add_pod(pod)
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    cluster.delete_pod(pod)
+    term.request(node, "test")
+    assert node.marked_for_deletion
+    assert TERMINATION_TAINT in node.taints
+    res = term.reconcile()
+    assert res.terminated == [node.name]
+    assert not cluster.nodes
+    assert not cloud.running()
+    assert term.pending == []
+
+
+def test_drain_evicts_owned_pods_as_pending():
+    clock, cloud, provider, cluster, prov, term = env()
+    pods = [cpu_pod(cpu_m=300) for _ in range(3)]
+    cluster.add_pods(pods)
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    res = term.drain_sync(node)
+    assert node.name in res.terminated
+    assert len(res.evicted) == 3
+    # owned pods get recreated pending
+    assert len(cluster.pending_pods()) == 3
+    assert not cloud.running()
+
+
+def test_drain_deletes_ownerless_pods():
+    clock, cloud, provider, cluster, prov, term = env()
+    naked = cpu_pod(cpu_m=300, owner_kind="")
+    cluster.add_pod(naked)
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    res = term.drain_sync(node)
+    assert node.name in res.terminated
+    assert naked.uid not in cluster.pods      # gone for good
+    assert not cluster.pending_pods()
+
+
+def test_daemon_pods_die_with_node_not_evicted():
+    clock, cloud, provider, cluster, prov, term = env()
+    app = cpu_pod(cpu_m=300)
+    cluster.add_pod(app)
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    ds = cpu_pod(cpu_m=50, owner_kind="DaemonSet")
+    cluster.add_pod(ds)
+    cluster.bind_pod(ds, node.name)
+    res = term.drain_sync(node)
+    assert node.name in res.terminated
+    assert ds.uid not in res.evicted
+    assert ds.uid not in cluster.pods
+
+
+def test_pdb_stalls_drain_until_budget_frees():
+    clock, cloud, provider, cluster, prov, term = env()
+    web = [cpu_pod(cpu_m=300, labels={"app": "web"}) for _ in range(2)]
+    cluster.add_pods(web)
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    # all web pods on one node; PDB allows only 1 voluntary eviction
+    cluster.add_pdb(PodDisruptionBudget(selector={"app": "web"},
+                                        min_available=1))
+    term.request(node)
+    r1 = term.reconcile()
+    assert len(r1.evicted) == 1               # one allowed, one blocked
+    assert r1.requeued == [node.name]
+    assert node.name in term.pending
+    assert len(cloud.running()) == 1          # instance NOT deleted yet
+    # evicted pod reschedules elsewhere (simulate: it binds somewhere) —
+    # its budget frees once it's healthy again
+    evicted = next(p for p in cluster.pending_pods())
+    prov.provision()                          # rebinds pending pod to a node
+    assert evicted.node_name
+    r2 = term.reconcile()
+    assert len(r2.evicted) == 1
+    assert node.name in r2.terminated         # drained → gone in same pass
+
+
+def test_reconcile_drops_vanished_nodes():
+    clock, cloud, provider, cluster, prov, term = env()
+    cluster.add_pod(cpu_pod())
+    prov.provision()
+    node = next(iter(cluster.nodes.values()))
+    term.request(node)
+    cluster.remove_node(node.name)            # deleted out from under us
+    res = term.reconcile()
+    assert res.terminated == [] and res.requeued == []
+    assert term.pending == []
+
+
+def test_disruption_routes_through_terminator():
+    clock, cloud, provider, cluster, prov, term = env()
+    pools = [NodePool()]
+    ctrl = DisruptionController(provider, cluster, pools, clock=clock,
+                                stabilization_s=0, terminator=term)
+    cluster.add_pods([cpu_pod(cpu_m=400)])
+    prov.provision()
+    cluster.add_pods([cpu_pod(cpu_m=1800, mem_mib=3000)])
+    prov.provision()
+    assert len(cluster.nodes) == 2
+    res = ctrl.reconcile()
+    assert res.action is not None
+    assert len(res.deleted) == 1
+    assert len(cluster.nodes) == 1
+    assert len(cloud.running()) == 1
+    assert not cluster.pending_pods()
