@@ -68,6 +68,10 @@ class Pod:
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
     pod_affinities: List[PodAffinityTerm] = field(default_factory=list)
+    # PV topology: zones the pod's persistent volumes restrict it to
+    # (reference scheduling surface "persistent volume topology";
+    # [] == unconstrained)
+    volume_zones: List[str] = field(default_factory=list)
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     priority: int = 0
@@ -86,6 +90,9 @@ class Pod:
         """nodeSelector ∧ (OR over required affinity terms), each branch a
         Requirements set — the pod-side input to compatibility masking."""
         base = Requirements.from_labels(self.node_selector)
+        if self.volume_zones:
+            base = base.union(Requirements.of(
+                Requirement(wk.ZONE, IN, self.volume_zones)))
         if not self.required_affinity_terms:
             return [base]
         return [base.union(term) for term in self.required_affinity_terms]

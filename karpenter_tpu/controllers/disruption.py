@@ -41,6 +41,7 @@ from ..catalog.instancetype import InstanceType
 from ..cloud.fake import CloudError
 from ..cloud.provider import CloudProvider, InsufficientCapacityError
 from ..ops.classpack import solve_classpack
+from ..ops.constraints import LEVEL_REQUIRED_ONLY, lower_pods
 from ..ops.ffd import PackingResult
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
@@ -150,7 +151,7 @@ class DisruptionController:
                 continue  # in-flight pod nomination
             blocked = False
             for p in node.pods:
-                if p.do_not_disrupt or (not p.owner_kind and not p.is_daemon):
+                if p.do_not_disrupt or not p.owner_kind:
                     blocked = True
                     break
             if blocked:
@@ -189,6 +190,9 @@ class DisruptionController:
                     eviction_threshold=it.eviction_threshold, info=it.info))
         return out
 
+    def _orig(self, p: Pod) -> Pod:
+        return self.cluster.original(p)
+
     def simulate(self, excluded: Sequence[Candidate],
                  allow_new: bool = False,
                  max_total_price: Optional[float] = None
@@ -199,8 +203,17 @@ class DisruptionController:
         pods = [p for c in excluded for p in c.reschedulable]
         catalog = self._filtered_catalog(max_total_price) if allow_new else []
         pools = list(self.nodepools.values())
-        problem = tensorize(pods, catalog, pools)
         exclude_names = [c.name for c in excluded]
+        # required-only lowering: preferences never block consolidation, but
+        # spread/anti-affinity must hold on the post-disruption cluster
+        zones = sorted({o.zone for it in catalog for o in it.offerings
+                        if o.available}
+                       | {n.zone for n in self.cluster.nodes.values()
+                          if n.name not in exclude_names and n.zone})
+        pods = lower_pods(pods, nodes=self.cluster.nodes.values(),
+                          option_zones=zones, exclude_nodes=exclude_names,
+                          level=LEVEL_REQUIRED_ONLY)
+        problem = tensorize(pods, catalog, pools)
         node_list, alloc, used, compat = self.cluster.tensorize_nodes(
             problem.class_reps, problem.axes, exclude=exclude_names)
         if len(node_list) == 0 and problem.num_options == 0:
@@ -387,7 +400,8 @@ class DisruptionController:
         if action.simulation is not None and action.simulation.nodes:
             from .provisioning import claim_from_decision
             for decision in action.simulation.nodes:
-                dpods = [action.problem.pods[i] for i in decision.pod_indices]
+                dpods = [self._orig(action.problem.pods[i])
+                         for i in decision.pod_indices]
                 claim = claim_from_decision(decision, dpods, self.nodepools)
                 try:
                     claim = self.provider.create(claim)
@@ -410,11 +424,12 @@ class DisruptionController:
         if action.simulation is not None:
             sim = action.simulation
             for pod_i, slot in sim.existing_assignments.items():
-                self.cluster.bind_pod(action.problem.pods[pod_i],
+                self.cluster.bind_pod(self._orig(action.problem.pods[pod_i]),
                                       action.surviving_nodes[slot].name)
             for node in new_nodes:
                 for pod_i in node._decision.pod_indices:
-                    self.cluster.bind_pod(action.problem.pods[pod_i], node.name)
+                    self.cluster.bind_pod(self._orig(action.problem.pods[pod_i]),
+                                          node.name)
 
         # terminate candidates — through the finalizer-drain flow when a
         # terminator is wired, else the inline state-level equivalent
@@ -434,11 +449,12 @@ class DisruptionController:
                 if c.claim is not None:
                     self.provider.delete(c.claim)
                     self.cluster.nodeclaims.pop(c.claim.name, None)
-            except CloudError as e:
-                if e.code != "InstanceNotFound":  # already gone == success
-                    # transient cloud failure: untaint so the next reconcile
-                    # retries this (now-empty) node instead of stranding a
-                    # billed zombie behind marked_for_deletion
+            except Exception as e:
+                already_gone = isinstance(e, CloudError) and e.code == "InstanceNotFound"
+                if not already_gone:
+                    # transient cloud failure (typed or not): untaint so the
+                    # next reconcile retries this (now-empty) node instead of
+                    # stranding a billed zombie behind marked_for_deletion
                     c.node.marked_for_deletion = False
                     c.node.taints = [t for t in c.node.taints
                                      if t.key != DISRUPTION_TAINT.key]

@@ -26,6 +26,8 @@ from ..api.objects import Node, NodeClaim, NodePool, Pod
 from ..api.requirements import IN, Requirement, Requirements
 from ..api.resources import PODS, ResourceList
 from ..cloud.provider import CloudProvider, InsufficientCapacityError
+from ..ops.constraints import (MAX_LEVEL, find_batch_topology_violations,
+                               has_soft_constraints, lower_pods)
 from ..ops.ffd import NodeDecision, PackingResult, solve_ffd
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
@@ -39,6 +41,9 @@ class ProvisioningResult:
     bound_existing: int = 0
     unschedulable: List[Pod] = field(default_factory=list)
     failed_launches: List[str] = field(default_factory=list)
+    # carriers of batch-internal anti-affinity violations, deferred to a
+    # follow-up solve (ops/constraints.py post-solve repair)
+    stranded: List[Pod] = field(default_factory=list)
     solve_seconds: float = 0.0
 
     bound_new: int = 0
@@ -106,21 +111,48 @@ class Provisioner:
 
     def solve(self, pods: Sequence[Pod],
               schedule_on_existing: bool = True) -> tuple:
-        """Tensorize + pack one batch. Returns (problem, PackingResult)."""
+        """Tensorize + pack one batch, relaxing soft constraints level by
+        level (preferred affinity, ScheduleAnyway spreads) while pods come
+        back unschedulable — the batched analog of karpenter-core's
+        preference-relaxation loop (see ops/constraints.py).
+        Returns (problem, PackingResult)."""
         pools = self._pools_within_limits()  # weight precedence is encoded in
         catalog = self.provider.get_instance_types()  # LaunchOption.weight_rank
-        problem = tensorize(pods, catalog, pools)
-        if schedule_on_existing and self.cluster.nodes:
-            node_list, alloc, used, compat = self.cluster.tensorize_nodes(
-                problem.class_reps, problem.axes)
-            result = solve_ffd(problem, max_nodes=self.max_nodes_per_round,
-                               existing_alloc=alloc, existing_used=used,
-                               existing_compat=compat)
-            result._existing_nodes = node_list
-        else:
-            result = solve_ffd(problem, max_nodes=self.max_nodes_per_round)
-            result._existing_nodes = []
-        return problem, result
+        zone_rank: Dict[str, float] = {}
+        for it in catalog:
+            for o in it.offerings:
+                if o.available:
+                    zone_rank[o.zone] = min(zone_rank.get(o.zone, float("inf")),
+                                            o.price)
+        # existing-node zones count as spread/affinity domains even when no
+        # offering is currently available there (e.g. ICE-blacklisted): a
+        # constrained pod can still bind to live capacity in that zone
+        zones = sorted(set(zone_rank) | {n.zone for n in self.cluster.nodes.values()
+                                         if n.zone})
+        soft = has_soft_constraints(pods)
+        best = None
+        for level in range(MAX_LEVEL + 1):
+            lowered = lower_pods(pods, nodes=self.cluster.nodes.values(),
+                                 option_zones=zones, zone_rank=zone_rank,
+                                 level=level)
+            problem = tensorize(lowered, catalog, pools)
+            if schedule_on_existing and self.cluster.nodes:
+                node_list, alloc, used, compat = self.cluster.tensorize_nodes(
+                    problem.class_reps, problem.axes)
+                result = solve_ffd(problem, max_nodes=self.max_nodes_per_round,
+                                   existing_alloc=alloc, existing_used=used,
+                                   existing_compat=compat)
+                result._existing_nodes = node_list
+            else:
+                result = solve_ffd(problem, max_nodes=self.max_nodes_per_round)
+                result._existing_nodes = []
+            if best is None or result.scheduled_count > best[1].scheduled_count:
+                best = (problem, result)
+            if not result.unschedulable or not soft:
+                break
+            log.info("relaxing soft constraints to level %d (%d unschedulable)",
+                     level + 1, len(result.unschedulable))
+        return best
 
     def provision(self, pods: Optional[Sequence[Pod]] = None,
                   max_retries: int = 1) -> ProvisioningResult:
@@ -141,6 +173,21 @@ class Provisioner:
             out.bound_new += retry.bound_new
             out.unschedulable = retry.unschedulable
             out.failed_launches.extend(retry.failed_launches)
+            out.stranded.extend(retry.stranded)
+        # anti-affinity carriers stranded by the post-solve repair: their
+        # targets are now bound, so one follow-up solve sees them as
+        # existing pods and the NotIn lowering applies
+        strand_rounds = 0
+        while out.stranded and strand_rounds < 2:
+            strand_rounds += 1
+            retry = self._provision_once([p for p in out.stranded
+                                          if not p.node_name])
+            out.launched.extend(retry.launched)
+            out.bound_existing += retry.bound_existing
+            out.bound_new += retry.bound_new
+            out.unschedulable.extend(retry.unschedulable)
+            out.failed_launches.extend(retry.failed_launches)
+            out.stranded = retry.stranded
         return out
 
     def _provision_once(self, pods: Optional[Sequence[Pod]] = None) -> ProvisioningResult:
@@ -157,15 +204,30 @@ class Provisioner:
         out.solve_seconds = self.clock() - t0
         catalog_by_name = {it.name: it for it in self.provider.get_instance_types()}
 
+        orig = self.cluster.original
+
+        # batch-internal anti-affinity/spread the masks couldn't see: strand
+        # the violating carriers; they re-solve against bound targets
+        stranded = find_batch_topology_violations(
+            problem, packing, packing._existing_nodes)
+        out.stranded = [orig(problem.pods[i]) for i in stranded]
+
         # pods placed on existing nodes
         for pod_i, slot in packing.existing_assignments.items():
+            if pod_i in stranded:
+                continue
             node = packing._existing_nodes[slot]
-            self.cluster.bind_pod(problem.pods[pod_i], node.name)
+            self.cluster.bind_pod(orig(problem.pods[pod_i]), node.name)
             out.bound_existing += 1
 
         # new nodes
         for decision in packing.nodes:
-            dpods = [problem.pods[i] for i in decision.pod_indices]
+            if stranded:
+                decision.pod_indices = [i for i in decision.pod_indices
+                                        if i not in stranded]
+                if not decision.pod_indices:
+                    continue
+            dpods = [orig(problem.pods[i]) for i in decision.pod_indices]
             claim = claim_from_decision(decision, dpods, self.nodepools)
             try:
                 claim = self.provider.create(claim)
@@ -185,5 +247,6 @@ class Provisioner:
             out.bound_new += len(dpods)
             out.launched.append(claim)
 
-        out.unschedulable.extend(problem.pods[i] for i in packing.unschedulable)
+        out.unschedulable.extend(orig(problem.pods[i])
+                                 for i in packing.unschedulable)
         return out

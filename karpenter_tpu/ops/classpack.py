@@ -40,6 +40,7 @@ _BIG = np.int32(2**30)
 def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
                       counts: jax.Array,     # C int32
                       compat: jax.Array,     # C×(O+E) bool
+                      node_cap: jax.Array,   # C int32 max class pods per node
                       alloc: jax.Array,      # (O+E)×R int32
                       price: jax.Array,      # (O+E) f32; +inf == not launchable
                       rank: jax.Array,       # (O+E) int32 pool-weight rank
@@ -47,12 +48,16 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
                       init_used: jax.Array,    # K×R int32
                       max_nodes: int,
                       emit_takes: bool = False):
+    """`node_cap` lowers hostname-granular topology constraints (hostname
+    anti-affinity -> 1, hostname spread -> max_skew; see ops/constraints.py).
+    Each class is placed in exactly one scan step, so clamping per-slot and
+    per-new-node occupancy inside the step enforces the cap exactly."""
     K = max_nodes
     idx = jnp.arange(K)
 
     def step(carry, x):
         slot_option, slot_used, n_open, n_unsched = carry
-        req, cnt, comp = x
+        req, cnt, comp, cap = x
         opt = jnp.maximum(slot_option, 0)
         open_mask = slot_option >= 0
         free = alloc[opt] - slot_used                       # K×R
@@ -60,6 +65,7 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
         safe_req = jnp.where(reqpos, req, 1)
         fit = jnp.min(jnp.where(reqpos[None, :], free // safe_req[None, :], _BIG),
                       axis=-1)                              # pods each slot absorbs
+        fit = jnp.minimum(fit, cap)                         # hostname-cap clamp
         fit = jnp.where(open_mask & comp[opt], jnp.maximum(fit, 0), 0)
         prefix = jnp.cumsum(fit) - fit                      # exclusive cumsum
         take = jnp.clip(cnt - prefix, 0, fit)               # greedy first-fit fill
@@ -70,6 +76,7 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
         # reference's "maximize additional pods packed" tie-break
         m = jnp.min(jnp.where(reqpos[None, :], alloc // safe_req[None, :], _BIG),
                     axis=-1)                                # pods per fresh node
+        m = jnp.minimum(m, cap)                             # hostname-cap clamp
         ok = comp & (m > 0) & jnp.isfinite(price)
         # pool precedence: restrict to the best (lowest) weight-rank available
         best_rank = jnp.min(jnp.where(ok, rank, _BIG))
@@ -102,12 +109,13 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
     # axis annotations) stay consistent between init and body outputs
     (slot_option, slot_used, n_open, n_unsched), takes = jax.lax.scan(
         step, (init_option, init_used, n_open0, jnp.zeros_like(n_open0)),
-        (requests, counts, compat))
+        (requests, counts, compat, node_cap))
     return slot_option, slot_used, n_open, n_unsched, takes
 
 
 @partial(jax.jit, static_argnames=("max_nodes",))
-def class_pack_aggregate_kernel(requests, counts, compat, alloc, price, rank,
+def class_pack_aggregate_kernel(requests, counts, compat, node_cap,
+                                alloc, price, rank,
                                 init_option, init_used, max_nodes: int):
     """Pack and reduce to the aggregate launch plan ON DEVICE, returning one
     flat float32 vector: [total_cost, n_open, n_unsched, nodes_per_option…].
@@ -117,7 +125,7 @@ def class_pack_aggregate_kernel(requests, counts, compat, alloc, price, rank,
     tunneled dev TPUs (~70ms per D2H round trip) and real pods (syncs stall
     the dispatch pipeline)."""
     slot_option, slot_used, n_open, n_unsched, _ = class_pack_kernel(
-        requests, counts, compat, alloc, price, rank,
+        requests, counts, compat, node_cap, alloc, price, rank,
         init_option, init_used, max_nodes, False)
     opt = jnp.maximum(slot_option, 0)
     # count only newly-launchable options: pre-opened (virtual) and padded
@@ -138,8 +146,10 @@ def _sorted_classes(problem: Problem, extra_compat: Optional[np.ndarray]):
     compat = problem.class_compat[order]
     if extra_compat is not None:
         compat = np.concatenate([compat, extra_compat[order]], axis=1)
+    caps = (problem.class_node_cap if problem.class_node_cap is not None
+            else np.full(problem.num_classes, 2**30, np.int32))
     return (problem.class_requests[order], problem.class_counts[order],
-            compat, order)
+            compat, caps[order], order)
 
 
 def solve_classpack(problem: Problem,
@@ -158,7 +168,7 @@ def solve_classpack(problem: Problem,
     if E:
         ec = existing_compat if existing_compat is not None else \
             np.ones((problem.num_classes, E), bool)
-    requests, counts, compat, order = _sorted_classes(problem, ec)
+    requests, counts, compat, caps, order = _sorted_classes(problem, ec)
     C, R = requests.shape
     alloc = problem.option_alloc
     price = problem.option_price.astype(np.float32)
@@ -182,6 +192,8 @@ def solve_classpack(problem: Problem,
     req_p[:C] = requests.astype(np.int32)
     cnt_p = np.zeros(Cpad, np.int32)
     cnt_p[:C] = counts
+    cap_p = np.full(Cpad, 2**30, np.int32)
+    cap_p[:C] = caps
     comp_p = np.zeros((Cpad, Opad), bool)
     comp_p[:C, :alloc.shape[0]] = compat
     alloc_p = np.zeros((Opad, R), np.float32)
@@ -204,6 +216,7 @@ def solve_classpack(problem: Problem,
 
     kernel_args = (
         jnp.asarray(req_p), jnp.asarray(cnt_p), jnp.asarray(comp_p),
+        jnp.asarray(cap_p),
         jnp.asarray(alloc.astype(np.int32)), jnp.asarray(price),
         jnp.asarray(rank),
         jnp.asarray(init_option), jnp.asarray(init_used))

@@ -35,6 +35,8 @@ NO_ASSIGNMENT = -1
 def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
                     compat: jax.Array,      # P×O bool
                     valid: jax.Array,       # P bool (padding mask)
+                    class_id: jax.Array,    # P int32 (rows of a class contiguous)
+                    node_cap: jax.Array,    # P int32 max class pods per node
                     alloc: jax.Array,       # O×R full-capacity allocatable
                     price: jax.Array,       # O
                     rank: jax.Array,        # O int32 pool-weight rank
@@ -42,17 +44,25 @@ def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
                     init_used: jax.Array,   # K×R resources already used
                     max_nodes: int):
     """Returns (assignment P int32 slot-or--1, slot_option K, slot_used K×R,
-    n_open)."""
+    n_open).
+
+    `node_cap` lowers hostname-granular topology constraints (hostname
+    anti-affinity -> 1, hostname spread -> max_skew; ops/constraints.py):
+    a K-vector counts pods of the *current* class per slot and resets when
+    the scan crosses a class boundary — exact because FFD order keeps class
+    rows contiguous."""
     K = max_nodes
     _IBIG = jnp.int32(2**30)
 
     def step(carry, x):
-        slot_option, slot_used, n_open = carry
-        req, comp, is_valid = x
+        slot_option, slot_used, slot_cls, prev_cid, n_open = carry
+        req, comp, is_valid, cid, cap = x
+        slot_cls = jnp.where(cid == prev_cid, slot_cls, 0)
         opt = jnp.maximum(slot_option, 0)
         open_mask = slot_option >= 0
         slot_alloc = alloc[opt]                                   # K×R gather
-        fits = open_mask & comp[opt] & jnp.all(slot_used + req <= slot_alloc, axis=-1)
+        fits = (open_mask & comp[opt] & (slot_cls < cap)
+                & jnp.all(slot_used + req <= slot_alloc, axis=-1))
         exist_k = jnp.argmax(fits)            # first-fit: lowest feasible slot
         any_fit = jnp.any(fits)
         # new node: highest-weight pool first (NodePool.spec.weight
@@ -70,14 +80,18 @@ def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
         k = jnp.where(sched_exist, exist_k, n_open)
         k_safe = jnp.clip(k, 0, K - 1)
         slot_used = slot_used.at[k_safe].add(jnp.where(placed, req, 0.0))
+        slot_cls = slot_cls.at[k_safe].add(placed.astype(jnp.int32))
         slot_option = slot_option.at[k_safe].set(
             jnp.where(sched_new, new_opt, slot_option[k_safe]))
         n_open = n_open + sched_new.astype(jnp.int32)
-        return (slot_option, slot_used, n_open), jnp.where(placed, k_safe, NO_ASSIGNMENT)
+        carry = (slot_option, slot_used, slot_cls, cid, n_open)
+        return carry, jnp.where(placed, k_safe, NO_ASSIGNMENT)
 
     n_open0 = jnp.sum(init_option >= 0).astype(jnp.int32)
-    (slot_option, slot_used, n_open), assignment = jax.lax.scan(
-        step, (init_option, init_used, n_open0), (requests, compat, valid))
+    (slot_option, slot_used, _, _, n_open), assignment = jax.lax.scan(
+        step, (init_option, init_used, jnp.zeros(K, jnp.int32),
+               jnp.int32(-1), n_open0),
+        (requests, compat, valid, class_id, node_cap))
     return assignment, slot_option, slot_used, n_open
 
 
@@ -124,7 +138,10 @@ def solve_ffd(problem: Problem,
     if E:
         ec = existing_compat if existing_compat is not None else \
             np.ones((problem.num_classes, E), bool)
-    requests, compat, pod_idx = problem.expand(extra_compat=ec)
+    requests, compat, pod_idx, class_ids = problem.expand(extra_compat=ec)
+    caps = (problem.class_node_cap if problem.class_node_cap is not None
+            else np.full(problem.num_classes, 2**30, np.int32))
+    row_caps = caps[class_ids] if len(class_ids) else np.zeros(0, np.int32)
     P = len(requests)
     alloc = problem.option_alloc
     price = problem.option_price
@@ -157,6 +174,10 @@ def solve_ffd(problem: Problem,
     comp_p[:P, :alloc.shape[0]] = compat
     valid = np.zeros(Ppad, bool)
     valid[:P] = True
+    cid_p = np.full(Ppad, -2, np.int32)   # padded rows: no real class
+    cid_p[:P] = class_ids
+    cap_p = np.full(Ppad, 2**30, np.int32)
+    cap_p[:P] = row_caps
     alloc_p = np.zeros((Opad, R), np.float32)
     alloc_p[:alloc.shape[0]] = alloc
     price_p = np.full(Opad, np.inf, np.float32)
@@ -172,6 +193,7 @@ def solve_ffd(problem: Problem,
 
     assignment, slot_option, slot_used, n_open = ffd_pack_kernel(
         jnp.asarray(req_p), jnp.asarray(comp_p), jnp.asarray(valid),
+        jnp.asarray(cid_p), jnp.asarray(cap_p),
         jnp.asarray(alloc_p), jnp.asarray(price_p), jnp.asarray(rank_p),
         jnp.asarray(init_option), jnp.asarray(init_used), K)
     assignment = np.asarray(assignment)[:P]

@@ -65,6 +65,9 @@ class Problem:
     option_alloc: np.ndarray        # O×R float32
     option_price: np.ndarray        # O float32
     option_rank: np.ndarray = None  # O int32 pool-weight rank (0 = preferred)
+    # per-class max pods per node (hostname spread / anti-affinity lowering;
+    # _CAP_BIG == unconstrained)
+    class_node_cap: np.ndarray = None  # C int32
     option_zone: np.ndarray = None  # O int32
     option_captype: np.ndarray = None  # O int32 (0=on-demand, 1=spot)
     zones: List[str] = field(default_factory=list)
@@ -98,9 +101,11 @@ class Problem:
         """Expand classes to per-pod rows, FFD-sorted (largest first, as the
         reference sorts pods by resources descending,
         /root/reference/designs/bin-packing.md:16-20). Returns
-        (requests P×R, compat P×(O[+E]), pod_index P). `extra_compat` (C×E,
-        e.g. per-existing-node feasibility) is expanded and appended as extra
-        columns in the same row order."""
+        (requests P×R, compat P×(O[+E]), pod_index P, class_id P). The sort
+        is stable on class rank, so rows of one class stay contiguous — the
+        pod-granular kernel's per-class node-cap counter relies on that.
+        `extra_compat` (C×E, e.g. per-existing-node feasibility) is expanded
+        and appended as extra columns in the same row order."""
         class_ids = np.repeat(np.arange(self.num_classes), self.class_counts)
         requests = self.class_requests[class_ids]
         compat = self.class_compat[class_ids]
@@ -112,8 +117,9 @@ class Problem:
             class_rank = np.empty(self.num_classes, np.int64)
             class_rank[self.class_order()] = np.arange(self.num_classes)
             order = np.argsort(class_rank[class_ids], kind="stable")
-            requests, compat, pod_idx = requests[order], compat[order], pod_idx[order]
-        return requests.astype(np.float32), compat, pod_idx
+            requests, compat = requests[order], compat[order]
+            pod_idx, class_ids = pod_idx[order], class_ids[order]
+        return requests.astype(np.float32), compat, pod_idx, class_ids.astype(np.int32)
 
 
 def _class_key(pod: Pod) -> tuple:
@@ -121,13 +127,36 @@ def _class_key(pod: Pod) -> tuple:
         tuple(sorted(pod.requests.nonzero().items())),
         tuple(sorted(pod.node_selector.items())),
         tuple(repr(t) for t in pod.required_affinity_terms),
+        tuple((w, repr(t)) for w, t in pod.preferred_affinity_terms),
+        tuple(sorted(pod.volume_zones)),
         tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
         tuple((c.topology_key, c.max_skew, c.when_unsatisfiable,
                tuple(sorted(c.label_selector.items()))) for c in pod.topology_spread),
         tuple((a.topology_key, a.anti, a.required,
                tuple(sorted(a.label_selector.items()))) for a in pod.pod_affinities),
         tuple(sorted(pod.labels.items())),
+        pod.namespace,
     )
+
+
+_CAP_BIG = 2**30
+
+
+def _node_cap(pod: Pod) -> int:
+    """Max pods of this class one node may hold — the kernel-enforced
+    lowering of hostname-granular constraints (ops/constraints.py docstring):
+    hostname topology spread -> max_skew; required self anti-affinity over
+    hostname -> 1."""
+    cap = _CAP_BIG
+    for c in pod.topology_spread:
+        if c.topology_key == wk.HOSTNAME:
+            cap = min(cap, max(1, int(c.max_skew)))
+    for a in pod.pod_affinities:
+        if (a.anti and a.required and a.topology_key == wk.HOSTNAME
+                and all(pod.labels.get(k) == v
+                        for k, v in a.label_selector.items())):
+            cap = 1
+    return cap
 
 
 def build_options(catalog: Sequence[InstanceType],
@@ -244,6 +273,7 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
         class_counts=np.asarray([len(m) for m in members], np.int32),
         class_compat=class_compat,
         class_members=members,
+        class_node_cap=np.asarray([_node_cap(rep) for rep in reps], np.int32),
         options=options,
         option_alloc=option_alloc,
         option_price=option_price,

@@ -59,8 +59,8 @@ def split_counts(counts: np.ndarray, n_shards: int) -> np.ndarray:
 
 
 @partial(jax.jit, static_argnames=("max_nodes_per_shard", "mesh"))
-def _sharded_pack(requests, counts_sharded, compat, alloc, price, rank,
-                  max_nodes_per_shard: int, mesh: Mesh):
+def _sharded_pack(requests, counts_sharded, compat, node_cap, alloc, price,
+                  rank, max_nodes_per_shard: int, mesh: Mesh):
     """shard_map'd pack: every device scans its pod slice, then the launch
     plan is psum-aggregated over the mesh."""
     O = alloc.shape[0]
@@ -77,7 +77,7 @@ def _sharded_pack(requests, counts_sharded, compat, alloc, price, rank,
         # same guarded reduction as the single-chip aggregate path —
         # flat = [cost, n_open, n_unsched, nodes_per_option…]
         flat = class_pack_aggregate_kernel(
-            requests, counts_local, compat, alloc, price, rank,
+            requests, counts_local, compat, node_cap, alloc, price, rank,
             init_option, init_used, K)
         # ICI collective: the global launch plan every host can act on
         return jax.lax.psum(flat, SHARD_AXIS)[None]
@@ -113,6 +113,9 @@ def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
     price[:O] = problem.option_price
     rank = np.full(Opad, 2**30 - 1, np.int32)
     rank[:O] = problem.option_rank
+    node_cap = np.full(Cpad, 2**30, np.int32)
+    if problem.class_node_cap is not None:
+        node_cap[:C] = problem.class_node_cap[order]
 
     counts_sharded = np.zeros((n, Cpad), np.int32)
     counts_sharded[:, :C] = split_counts(
@@ -120,6 +123,7 @@ def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
 
     cost, nodes_per_option, unsched = _sharded_pack(
         jnp.asarray(requests), jnp.asarray(counts_sharded), jnp.asarray(compat),
+        jnp.asarray(node_cap),
         jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank),
         max_nodes_per_shard, mesh)
     cost, nodes_per_option, unsched = jax.device_get(

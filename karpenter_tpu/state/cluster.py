@@ -71,6 +71,12 @@ class Cluster:
     def pending_pods(self) -> List[Pod]:
         return [p for p in self.pods.values() if not p.node_name]
 
+    def original(self, pod: Pod) -> Pod:
+        """Map a constraint-lowered pod copy (ops/constraints.py) back to the
+        cluster's original object.  Controllers must always bind the
+        original, never a rewritten copy."""
+        return self.pods.get(pod.uid, pod)
+
     # ---- nodes / claims ----
     def add_node(self, node: Node) -> Node:
         self.nodes[node.name] = node

@@ -45,7 +45,7 @@ def oracle_ffd(problem: Problem,
                existing_compat: Optional[np.ndarray] = None):
     """Pure-Python first-fit-decreasing with cheapest-new-node: the oracle the
     scan kernel must match exactly (same ordering rules)."""
-    requests, compat, pod_idx = problem.expand()
+    requests, compat, pod_idx, _ = problem.expand()
     alloc = problem.option_alloc
     price = problem.option_price
     E = 0 if existing_alloc is None else len(existing_alloc)

@@ -1,0 +1,471 @@
+"""Topology constraint surface: spread, pod (anti-)affinity, PV topology,
+preferred-term relaxation — the reference's scheduling constraint matrix
+(/root/reference/website/content/en/docs/concepts/scheduling.md sections
+on topology spread and pod affinity) lowered per ops/constraints.py."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (Node, NodePool, Pod, PodAffinityTerm,
+                                       TopologySpreadConstraint)
+from karpenter_tpu.api.requirements import IN, NOT_IN, Requirement, Requirements
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.controllers.provisioning import Provisioner
+from karpenter_tpu.ops.constraints import (LEVEL_REQUIRED_ONLY, greedy_spread,
+                                           lower_pods)
+from karpenter_tpu.ops.classpack import solve_classpack
+from karpenter_tpu.ops.ffd import solve_ffd
+from karpenter_tpu.ops.tensorize import tensorize
+from karpenter_tpu.state.cluster import Cluster
+
+from helpers import cpu_pod, make_type, small_catalog
+
+ZONES3 = ("zone-a", "zone-b", "zone-c")
+
+
+def catalog3():
+    return [make_type("a.large", 8, 16, 0.40, zones=ZONES3),
+            make_type("a.small", 2, 4, 0.10, zones=ZONES3)]
+
+
+def spread_pod(key=wk.ZONE, skew=1, when="DoNotSchedule", app="web", **kw):
+    return cpu_pod(labels={"app": app},
+                   topology_spread=[TopologySpreadConstraint(
+                       topology_key=key, max_skew=skew,
+                       when_unsatisfiable=when,
+                       label_selector={"app": app})], **kw)
+
+
+def anti_pod(key=wk.HOSTNAME, app="web", required=True, **kw):
+    return cpu_pod(labels={"app": app},
+                   pod_affinities=[PodAffinityTerm(
+                       topology_key=key, label_selector={"app": app},
+                       anti=True, required=required)], **kw)
+
+
+def zones_of(problem, result):
+    out = []
+    for nd in result.nodes:
+        out.extend([nd.option.zone] * len(nd.pod_indices))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# greedy spread assignment
+# ---------------------------------------------------------------------------
+
+def _shares(assign):
+    out = {}
+    for d in assign.values():
+        out[d] = out.get(d, 0) + 1
+    return out
+
+
+def test_greedy_spread_balances_empty():
+    elig = {i: ["a", "b", "c"] for i in range(7)}
+    assert _shares(greedy_spread(range(7), elig, {})) == {"a": 3, "b": 2, "c": 2}
+
+
+def test_greedy_spread_fills_valleys_first():
+    elig = {i: ["a", "b"] for i in range(3)}
+    assert _shares(greedy_spread(range(3), elig, {"a": 5})) == {"b": 3}
+
+
+def test_greedy_spread_levels_then_balances():
+    elig = {i: ["a", "b"] for i in range(6)}
+    assert _shares(greedy_spread(range(6), elig, {"a": 2})) == {"a": 2, "b": 4}
+
+
+def test_greedy_spread_honors_per_member_eligibility():
+    # member 1 can only go to zone-a; member 0 is flexible — both schedule
+    elig = {0: ["a", "b"], 1: ["a"]}
+    assign = greedy_spread([0, 1], elig, {})
+    assert assign[1] == "a" and assign[0] == "b"
+
+
+def test_greedy_spread_no_eligible_domain_is_none():
+    assert greedy_spread([0], {0: []}, {})[0] is None
+
+
+# ---------------------------------------------------------------------------
+# zone topology spread
+# ---------------------------------------------------------------------------
+
+def test_zone_spread_balances_across_zones():
+    pods = [spread_pod() for _ in range(9)]
+    lowered = lower_pods(pods, option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    zc = {z: 0 for z in ZONES3}
+    for z in zones_of(prob, result):
+        zc[z] += 1
+    assert max(zc.values()) - min(zc.values()) <= 1
+
+
+def test_zone_spread_respects_existing_pods():
+    # zone-a already carries 4 matching pods; 2 new ones go elsewhere
+    node = Node(name="n1", zone="zone-a", capacity_type="on-demand",
+                pods=[Pod(labels={"app": "web"}) for _ in range(4)])
+    pods = [spread_pod() for _ in range(2)]
+    lowered = lower_pods(pods, nodes=[node], option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    assert set(zones_of(prob, result)) <= {"zone-b", "zone-c"}
+
+
+def test_zone_spread_unschedulable_when_assigned_zone_has_no_capacity():
+    # spread forces one pod into each zone but the catalog only offers zone-a
+    catalog = [make_type("a.large", 8, 16, 0.40, zones=("zone-a",))]
+    pods = [spread_pod() for _ in range(3)]
+    lowered = lower_pods(pods, option_zones=["zone-a"])
+    prob = tensorize(lowered, catalog, [NodePool()])
+    result = solve_classpack(prob)
+    # only one eligible domain -> all pods legally stack there (global skew
+    # counts eligible domains only)
+    assert not result.unschedulable
+
+
+def test_capacity_type_spread_splits_od_spot():
+    catalog = [make_type("a.large", 8, 16, 0.40, spot_discount=0.5)]
+    pods = [spread_pod(key=wk.CAPACITY_TYPE) for _ in range(8)]
+    lowered = lower_pods(pods, option_zones=("zone-a", "zone-b"))
+    prob = tensorize(lowered, catalog, [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    ct = {"on-demand": 0, "spot": 0}
+    for nd in result.nodes:
+        ct[nd.option.capacity_type] += len(nd.pod_indices)
+    assert abs(ct["on-demand"] - ct["spot"]) <= 1
+
+
+# ---------------------------------------------------------------------------
+# hostname spread / anti-affinity (kernel node cap)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("solver", [solve_classpack, solve_ffd])
+def test_hostname_anti_affinity_one_pod_per_node(solver):
+    pods = [anti_pod() for _ in range(5)]
+    prob = tensorize(pods, catalog3(), [NodePool()])
+    assert prob.class_node_cap.min() == 1
+    result = solver(prob)
+    assert not result.unschedulable
+    assert len(result.nodes) == 5
+    assert all(len(nd.pod_indices) == 1 for nd in result.nodes)
+
+
+@pytest.mark.parametrize("solver", [solve_classpack, solve_ffd])
+def test_hostname_spread_caps_pods_per_node(solver):
+    pods = [spread_pod(key=wk.HOSTNAME, skew=2) for _ in range(6)]
+    prob = tensorize(pods, catalog3(), [NodePool()])
+    result = solver(prob)
+    assert not result.unschedulable
+    assert all(len(nd.pod_indices) <= 2 for nd in result.nodes)
+    assert len(result.nodes) >= 3
+
+
+def test_hostname_anti_affinity_skips_existing_nodes_with_match():
+    cat = catalog3()
+    node = Node(name="busy", zone="zone-a", capacity_type="on-demand",
+                labels={wk.HOSTNAME: "busy"},
+                allocatable=cat[0].allocatable,
+                pods=[Pod(labels={"app": "web"})])
+    pods = [anti_pod()]
+    lowered = lower_pods(pods, nodes=[node], option_zones=ZONES3)
+    prob = tensorize(lowered, cat, [NodePool()])
+    _, alloc, used, compat = Cluster().tensorize_nodes.__func__(
+        _cluster_with(node), prob.class_reps, prob.axes)
+    result = solve_classpack(prob, existing_alloc=alloc, existing_used=used,
+                             existing_compat=compat)
+    # pod must open a new node, not join the matching one
+    assert not result.existing_assignments
+    assert len(result.nodes) == 1
+
+
+def _cluster_with(*nodes):
+    c = Cluster()
+    for n in nodes:
+        c.add_node(n)
+        for p in n.pods:
+            p.node_name = n.name
+            c.pods[p.uid] = p
+    return c
+
+
+# ---------------------------------------------------------------------------
+# zone anti-affinity / affinity
+# ---------------------------------------------------------------------------
+
+def test_zone_anti_affinity_distinct_zones():
+    pods = [anti_pod(key=wk.ZONE) for _ in range(3)]
+    lowered = lower_pods(pods, option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    zs = zones_of(prob, result)
+    assert len(zs) == 3 and len(set(zs)) == 3
+
+
+def test_zone_anti_affinity_overflow_unschedulable():
+    pods = [anti_pod(key=wk.ZONE) for _ in range(5)]
+    lowered = lower_pods(pods, option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert len(result.unschedulable) == 2
+
+
+def test_zone_anti_affinity_avoids_existing_zone():
+    node = Node(name="n1", zone="zone-a", capacity_type="on-demand",
+                pods=[Pod(labels={"app": "web"})])
+    pods = [anti_pod(key=wk.ZONE) for _ in range(2)]
+    lowered = lower_pods(pods, nodes=[node], option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    assert set(zones_of(prob, result)) == {"zone-b", "zone-c"}
+
+
+def test_pod_affinity_follows_existing_pods_zone():
+    node = Node(name="n1", zone="zone-b", capacity_type="on-demand",
+                pods=[Pod(labels={"app": "cache"})])
+    pod = cpu_pod(pod_affinities=[PodAffinityTerm(
+        topology_key=wk.ZONE, label_selector={"app": "cache"})])
+    lowered = lower_pods([pod], nodes=[node], option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    assert zones_of(prob, result) == ["zone-b"]
+
+
+def test_pod_affinity_self_group_colocates_one_zone():
+    pods = [cpu_pod(labels={"app": "web"},
+                    pod_affinities=[PodAffinityTerm(
+                        topology_key=wk.ZONE, label_selector={"app": "web"})])
+            for _ in range(4)]
+    zone_rank = {"zone-a": 0.4, "zone-b": 0.2, "zone-c": 0.4}
+    lowered = lower_pods(pods, option_zones=ZONES3, zone_rank=zone_rank)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    assert set(zones_of(prob, result)) == {"zone-b"}  # cheapest zone
+
+
+def test_required_affinity_without_targets_unschedulable():
+    pod = cpu_pod(pod_affinities=[PodAffinityTerm(
+        topology_key=wk.ZONE, label_selector={"app": "no-such"})])
+    lowered = lower_pods([pod], option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert len(result.unschedulable) == 1
+
+
+# ---------------------------------------------------------------------------
+# PV topology
+# ---------------------------------------------------------------------------
+
+def test_volume_zones_restrict_placement():
+    pod = cpu_pod(volume_zones=["zone-c"])
+    prob = tensorize([pod], catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    assert zones_of(prob, result) == ["zone-c"]
+
+
+# ---------------------------------------------------------------------------
+# preferred-term relaxation through the Provisioner
+# ---------------------------------------------------------------------------
+
+class _StubProvider:
+    def __init__(self, catalog):
+        self._catalog = catalog
+        self.created = []
+
+    def get_instance_types(self, nodepool=None):
+        return self._catalog
+
+    def create(self, claim):
+        from karpenter_tpu.api.requirements import Requirements
+        claim.provider_id = f"fake-{len(self.created)}"
+        types = claim.requirements.get_values(wk.INSTANCE_TYPE)
+        claim.instance_type = sorted(types)[0]
+        claim.zone = sorted(claim.requirements.get_values(wk.ZONE))[0]
+        claim.capacity_type = "on-demand"
+        self.created.append(claim)
+        return claim
+
+
+def test_preferred_affinity_relaxed_when_unsatisfiable():
+    # preference points at a zone the catalog can't offer: the pod must
+    # still schedule (preference dropped), like the reference's relaxation
+    catalog = [make_type("a.large", 8, 16, 0.40, zones=("zone-a",))]
+    cluster = Cluster()
+    prov = Provisioner(_StubProvider(catalog), cluster, [NodePool()])
+    pod = cpu_pod(preferred_affinity_terms=[
+        (10, Requirements.of(Requirement(wk.ZONE, IN, ["zone-z"])))])
+    cluster.add_pod(pod)
+    res = prov.provision()
+    assert res.scheduled == 1
+    assert not res.unschedulable
+
+
+def test_preferred_affinity_honored_when_satisfiable():
+    catalog = catalog3()
+    cluster = Cluster()
+    prov = Provisioner(_StubProvider(catalog), cluster, [NodePool()])
+    pod = cpu_pod(preferred_affinity_terms=[
+        (10, Requirements.of(Requirement(wk.ZONE, IN, ["zone-c"])))])
+    cluster.add_pod(pod)
+    res = prov.provision()
+    assert res.scheduled == 1
+    assert res.launched[0].zone == "zone-c"
+
+
+def test_schedule_anyway_spread_drops_at_required_only():
+    pods = [spread_pod(when="ScheduleAnyway") for _ in range(3)]
+    lowered = lower_pods(pods, option_zones=ZONES3, level=LEVEL_REQUIRED_ONLY)
+    # at the required-only level the soft spread is stripped entirely
+    assert all(not p.topology_spread for p in lowered)
+
+
+def test_spread_member_with_conflicting_selector_schedules():
+    # review regression: one member pinned to zone-a by its own selector must
+    # get zone-a, not a blind share of another zone
+    pods = [spread_pod(), spread_pod(node_selector={wk.ZONE: "zone-a"})]
+    lowered = lower_pods(pods, option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+
+
+def test_hostname_spread_excludes_existing_nodes_with_group_pods():
+    # review regression: a node already carrying group pods must not absorb
+    # more members of a hostname DoNotSchedule spread
+    cat = catalog3()
+    node = Node(name="busy", zone="zone-a", capacity_type="on-demand",
+                labels={wk.HOSTNAME: "busy"},
+                allocatable=cat[0].allocatable,
+                pods=[Pod(labels={"app": "web"}) for _ in range(3)])
+    pods = [spread_pod(key=wk.HOSTNAME) for _ in range(2)]
+    lowered = lower_pods(pods, nodes=[node], option_zones=ZONES3)
+    cluster = _cluster_with(node)
+    prob = tensorize(lowered, cat, [NodePool()])
+    _, alloc, used, compat = cluster.tensorize_nodes(prob.class_reps, prob.axes)
+    result = solve_classpack(prob, existing_alloc=alloc, existing_used=used,
+                             existing_compat=compat)
+    assert not result.existing_assignments      # 'busy' takes nothing
+    assert not result.unschedulable
+
+
+def test_cross_class_anti_affinity_strands_then_schedules():
+    # review regression: pod A anti-affine (hostname) to app=db, pod B is
+    # app=db but NOT anti-affine — they must not co-locate
+    catalog = [make_type("big.node", 16, 32, 0.80, zones=("zone-a",))]
+    cluster = Cluster()
+    prov = Provisioner(_StubProviderBinding(catalog, cluster), cluster,
+                       [NodePool()])
+    a = cpu_pod(pod_affinities=[PodAffinityTerm(
+        topology_key=wk.HOSTNAME, label_selector={"app": "db"}, anti=True)])
+    b = cpu_pod(labels={"app": "db"})
+    cluster.add_pods([a, b])
+    res = prov.provision()
+    assert res.scheduled == 2
+    assert a.node_name and b.node_name and a.node_name != b.node_name
+
+
+def test_mutual_anti_affinity_pair_converges():
+    # review regression: A and B mutually anti-affine must both schedule on
+    # distinct nodes (stranding both forever would leave them pending)
+    catalog = [make_type("big.node", 16, 32, 0.80, zones=("zone-a",))]
+    cluster = Cluster()
+    prov = Provisioner(_StubProviderBinding(catalog, cluster), cluster,
+                       [NodePool()])
+    a = cpu_pod(labels={"app": "x"},
+                pod_affinities=[PodAffinityTerm(
+                    topology_key=wk.HOSTNAME, label_selector={"app": "y"},
+                    anti=True)])
+    b = cpu_pod(labels={"app": "y"},
+                pod_affinities=[PodAffinityTerm(
+                    topology_key=wk.HOSTNAME, label_selector={"app": "x"},
+                    anti=True)])
+    cluster.add_pods([a, b])
+    res = prov.provision()
+    assert res.scheduled == 2
+    assert a.node_name and b.node_name and a.node_name != b.node_name
+    assert not res.stranded
+
+
+def test_hostname_spread_across_classes_respects_skew():
+    # review regression: same spread group, two resource classes — max_skew 1
+    # still means at most one group pod per node
+    catalog = [make_type("big.node", 16, 32, 0.80, zones=("zone-a",))]
+    cluster = Cluster()
+    prov = Provisioner(_StubProviderBinding(catalog, cluster), cluster,
+                       [NodePool()])
+    spread = lambda: [TopologySpreadConstraint(
+        topology_key=wk.HOSTNAME, label_selector={"app": "web"})]
+    big = cpu_pod(cpu_m=2000, labels={"app": "web"}, topology_spread=spread())
+    small = cpu_pod(cpu_m=200, labels={"app": "web"}, topology_spread=spread())
+    cluster.add_pods([big, small])
+    res = prov.provision()
+    assert res.scheduled == 2
+    assert big.node_name != small.node_name
+
+
+def test_spread_pod_binds_to_existing_node_in_ice_zone():
+    # review regression: all zone-c offerings unavailable, but a live zone-c
+    # node with room must still count as a spread domain
+    cat = [make_type("a.large", 8, 16, 0.40, zones=ZONES3)]
+    cluster = Cluster()
+    node = Node(name="zc", zone="zone-c", capacity_type="on-demand",
+                labels={wk.HOSTNAME: "zc", wk.ZONE: "zone-c"},
+                allocatable=cat[0].allocatable,
+                pods=[Pod(labels={"app": "web"}) for _ in range(0)])
+    cluster.add_node(node)
+    # catalog visible to the provisioner has no zone-c offerings at all
+    visible = [make_type("a.large", 8, 16, 0.40, zones=("zone-a", "zone-b"))]
+    prov = Provisioner(_StubProviderBinding(visible, cluster), cluster,
+                       [NodePool()])
+    pod = cpu_pod(node_selector={wk.ZONE: "zone-c"}, labels={"app": "web"},
+                  topology_spread=[TopologySpreadConstraint(
+                      topology_key=wk.ZONE, label_selector={"app": "web"})])
+    cluster.add_pod(pod)
+    res = prov.provision()
+    assert res.bound_existing == 1
+    assert pod.node_name == "zc"
+
+
+class _StubProviderBinding(_StubProvider):
+    """Stub provider wired to a cluster (claims register as real nodes)."""
+
+    def __init__(self, catalog, cluster):
+        super().__init__(catalog)
+        self.cluster = cluster
+
+
+def test_level1_strips_soft_affinity_but_keeps_soft_spread():
+    # review regression: a non-required pod-affinity relaxes at level 1, but
+    # the pod's ScheduleAnyway spread survives until level 2
+    pod = cpu_pod(labels={"app": "web"},
+                  pod_affinities=[PodAffinityTerm(
+                      topology_key=wk.ZONE, label_selector={"app": "cache"},
+                      required=False)],
+                  topology_spread=[TopologySpreadConstraint(
+                      topology_key=wk.HOSTNAME, max_skew=1,
+                      when_unsatisfiable="ScheduleAnyway",
+                      label_selector={"app": "web"})])
+    lowered = lower_pods([pod], option_zones=ZONES3, level=1)
+    assert not lowered[0].pod_affinities          # soft affinity stripped
+    assert lowered[0].topology_spread             # soft spread kept
+
+
+def test_schedule_anyway_enforced_at_strict_level():
+    pods = [spread_pod(when="ScheduleAnyway") for _ in range(6)]
+    lowered = lower_pods(pods, option_zones=ZONES3)
+    prob = tensorize(lowered, catalog3(), [NodePool()])
+    result = solve_classpack(prob)
+    zc = {z: 0 for z in ZONES3}
+    for z in zones_of(prob, result):
+        zc[z] += 1
+    assert max(zc.values()) - min(zc.values()) <= 1

@@ -103,7 +103,7 @@ def test_gpu_requests_limit_compat():
 def test_expand_sorts_descending():
     pods = [cpu_pod(cpu_m=100), cpu_pod(cpu_m=4000), cpu_pod(cpu_m=1000)]
     prob = tensorize(pods, small_catalog(), [NodePool()])
-    req, _, pod_idx = prob.expand()
+    req, _, pod_idx, _ = prob.expand()
     cpu_axis = prob.axes.index(CPU)
     assert list(req[:, cpu_axis]) == [4000.0, 1000.0, 100.0]
     assert list(pod_idx) == [1, 2, 0]
