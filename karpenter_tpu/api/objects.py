@@ -181,6 +181,9 @@ class NodeClass:
     zone_selector: List[str] = field(default_factory=list)  # [] == all zones
     subnet_selector: Dict[str, str] = field(default_factory=dict)
     security_group_selector: Dict[str, str] = field(default_factory=dict)
+    # explicit image pin; empty == resolve latest published for the family
+    # (amiSelectorTerms analog, ec2nodeclass.go:30-113)
+    image_selector: Dict[str, str] = field(default_factory=dict)
     role: str = ""
     user_data: str = ""
     tags: Dict[str, str] = field(default_factory=dict)

@@ -36,6 +36,9 @@ class CloudInstance:
     tags: Dict[str, str] = field(default_factory=dict)
     state: str = "running"
     launched_at: float = field(default_factory=time.time)
+    subnet_id: str = ""
+    image_id: str = ""
+    launch_template: str = ""
 
 
 @dataclass
@@ -47,6 +50,9 @@ class FleetOverride:
     zone: str
     capacity_type: str
     price: float
+    subnet_id: str = ""
+    launch_template: str = ""
+    image_id: str = ""
 
 
 @dataclass
@@ -59,6 +65,50 @@ class FleetError:
 class FleetResult:
     instances: List[CloudInstance]
     errors: List[FleetError]
+
+
+@dataclass
+class SubnetInfo:
+    """A network placement target — subnet analog with free-IP accounting
+    (/root/reference/pkg/providers/subnet/subnet.go:59,110-147)."""
+    id: str
+    zone: str
+    available_ip_count: int
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SecurityGroupInfo:
+    """A firewall group discoverable by id/name/tags
+    (/root/reference/pkg/providers/securitygroup/securitygroup.go:54-76)."""
+    id: str
+    name: str
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ImageInfo:
+    """A bootable node image — AMI analog
+    (/root/reference/pkg/providers/amifamily/ami.go:116-136)."""
+    id: str
+    name: str
+    architecture: str = "amd64"
+    creation_ts: float = 0.0
+    deprecated: bool = False
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class LaunchTemplateInfo:
+    """A stored launch template
+    (/root/reference/pkg/providers/launchtemplate/launchtemplate.go:233)."""
+    name: str
+    image_id: str
+    user_data: str = ""
+    security_group_ids: Tuple[str, ...] = ()
+    block_device_gib: int = 20
+    instance_profile: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
 
 
 class FakeCloud:
@@ -76,6 +126,13 @@ class FakeCloud:
         self.next_error: Optional[Exception] = None
         self.calls: Dict[str, int] = {}
         self.queue = queue  # interruption events published here when attached
+        # network inventory (seeded by tests / the operator)
+        self.subnets: List[SubnetInfo] = []
+        self.security_groups: List[SecurityGroupInfo] = []
+        self.images: List[ImageInfo] = []
+        self.launch_templates: Dict[str, LaunchTemplateInfo] = {}
+        # (instance_type, zone) → spot price history, newest wins
+        self.spot_prices: Dict[Tuple[str, str], float] = {}
 
     # ---- test knobs ----
     def reset(self):
@@ -121,7 +178,9 @@ class FakeCloud:
                     inst = CloudInstance(
                         id=iid, instance_type=ov.instance_type, zone=ov.zone,
                         capacity_type=ov.capacity_type, price=ov.price,
-                        tags=dict(tags or {}), launched_at=self.clock())
+                        tags=dict(tags or {}), launched_at=self.clock(),
+                        subnet_id=ov.subnet_id, image_id=ov.image_id,
+                        launch_template=ov.launch_template)
                     self._instances[iid] = inst
                     instances.append(inst)
             return FleetResult(instances=instances, errors=errors)
@@ -162,6 +221,66 @@ class FakeCloud:
                     inst.state = "terminated"
                     done.append(iid)
             return done
+
+    def describe_subnets(self) -> List["SubnetInfo"]:
+        with self._lock:
+            self._count("describe_subnets")
+            self._maybe_raise()
+            return list(self.subnets)
+
+    def describe_security_groups(self) -> List["SecurityGroupInfo"]:
+        with self._lock:
+            self._count("describe_security_groups")
+            self._maybe_raise()
+            return list(self.security_groups)
+
+    def describe_images(self, ids: Optional[Sequence[str]] = None) -> List["ImageInfo"]:
+        with self._lock:
+            self._count("describe_images")
+            self._maybe_raise()
+            if ids is None:
+                return list(self.images)
+            want = set(ids)
+            return [i for i in self.images if i.id in want]
+
+    def create_launch_template(self, lt: "LaunchTemplateInfo") -> "LaunchTemplateInfo":
+        with self._lock:
+            self._count("create_launch_template")
+            self._maybe_raise()
+            if lt.name in self.launch_templates:
+                raise CloudError("InvalidLaunchTemplateName.AlreadyExistsException",
+                                 lt.name)
+            self.launch_templates[lt.name] = lt
+            return lt
+
+    def describe_launch_templates(self, tag_filter: Optional[Dict[str, str]] = None
+                                  ) -> List["LaunchTemplateInfo"]:
+        with self._lock:
+            self._count("describe_launch_templates")
+            self._maybe_raise()
+            out = []
+            for lt in self.launch_templates.values():
+                if tag_filter and any(lt.tags.get(k) != v
+                                      for k, v in tag_filter.items()):
+                    continue
+                out.append(lt)
+            return out
+
+    def delete_launch_template(self, name: str) -> None:
+        with self._lock:
+            self._count("delete_launch_template")
+            self._maybe_raise()
+            if name not in self.launch_templates:
+                raise CloudError("InvalidLaunchTemplateId.NotFound", name)
+            del self.launch_templates[name]
+
+    def describe_spot_price_history(self) -> Dict[Tuple[str, str], float]:
+        """(type, zone) → latest spot price
+        (/root/reference/pkg/providers/pricing/pricing.go:308+)."""
+        with self._lock:
+            self._count("describe_spot_price_history")
+            self._maybe_raise()
+            return dict(self.spot_prices)
 
     def create_tags(self, iid: str, tags: Dict[str, str]) -> None:
         with self._lock:

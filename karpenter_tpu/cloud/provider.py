@@ -136,13 +136,18 @@ class CloudProvider:
                  unavailable: Optional[UnavailableOfferings] = None,
                  node_classes: Optional[Dict[str, NodeClass]] = None,
                  cluster_name: str = "default",
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 subnets=None, launch_templates=None):
         self.cloud = cloud
         self.unavailable = unavailable or UnavailableOfferings()
         self.instance_types = InstanceTypesProvider(catalog, self.unavailable)
         self.node_classes = node_classes or {"default": NodeClass()}
         self.cluster_name = cluster_name
         self.clock = clock
+        # optional L2 wiring (providers/subnet.py, providers/launchtemplate.py);
+        # None keeps the bare fleet path for unit tests and benchmarks
+        self.subnets = subnets
+        self.launch_templates = launch_templates
         self._claims_by_provider_id: Dict[str, NodeClaim] = {}
 
     # ---- catalog ----
@@ -163,7 +168,52 @@ class CloudProvider:
         if not candidates:
             raise InsufficientCapacityError(
                 f"no compatible instance types for claim {claim.name}")
+        nodeclass = self.node_classes.get(claim.node_class_ref)
+        # zonal subnet choice with in-flight IP accounting
+        # (/root/reference/pkg/providers/instance/instance.go:197-253 →
+        #  subnet.go ZonalSubnetsForLaunch:110-147)
+        zonal_subnets = None
+        if self.subnets is not None and nodeclass is not None:
+            zonal_subnets = self.subnets.zonal_subnets_for_launch(nodeclass)
+            if not zonal_subnets:
+                raise InsufficientCapacityError(
+                    f"no subnets resolve for nodeclass {nodeclass.name}")
+        settled = []
+        try:
+            return self._launch(claim, candidates, nodeclass, zonal_subnets,
+                                settled)
+        finally:
+            # refund predictions the fleet response never settled (launch
+            # failed before/at create_fleet) so inflight counts can't leak
+            if zonal_subnets is not None and not settled:
+                self.subnets.update_inflight_ips([], zonal_subnets)
+
+    def _launch(self, claim: NodeClaim, candidates: List[InstanceType],
+                nodeclass: Optional[NodeClass], zonal_subnets,
+                settled: List[bool]) -> NodeClaim:
+        # launch-template ensure per (image × userdata) group; restricts
+        # candidates to types an image covers (launchtemplate.go EnsureAll)
+        lt_by_type: Dict[str, Tuple[str, str]] = {}
+        if self.launch_templates is not None and nodeclass is not None:
+            resolved = self.launch_templates.ensure_all(
+                nodeclass, candidates, labels=dict(claim.labels),
+                security_group_ids=tuple(nodeclass.status_security_groups),
+                instance_profile=nodeclass.status_instance_profile)
+            for rt in resolved:
+                for it in rt.instance_types:
+                    lt_by_type[it.name] = (rt.template.name, rt.template.image_id)
+            candidates = [it for it in candidates if it.name in lt_by_type]
+            if not candidates:
+                raise InsufficientCapacityError(
+                    f"no image covers any candidate type for claim {claim.name}")
         overrides = _build_overrides(claim, candidates)
+        if zonal_subnets is not None:
+            overrides = [ov for ov in overrides if ov.zone in zonal_subnets]
+            for ov in overrides:
+                ov.subnet_id = zonal_subnets[ov.zone].id
+        for ov in overrides:
+            if ov.instance_type in lt_by_type:
+                ov.launch_template, ov.image_id = lt_by_type[ov.instance_type]
         if not overrides:
             raise InsufficientCapacityError(
                 f"no available offerings for claim {claim.name}")
@@ -174,6 +224,12 @@ class CloudProvider:
             "Name": f"{claim.nodepool}/{claim.name}",
         }
         result = self.cloud.create_fleet(overrides, count=1, tags=tags)
+        # settle the in-flight IP predictions against where the launch landed
+        # (subnet.go UpdateInflightIPs:149)
+        if zonal_subnets is not None:
+            self.subnets.update_inflight_ips(
+                [i.subnet_id for i in result.instances], zonal_subnets)
+            settled.append(True)
         # feed partial failures back into the ICE cache
         # (instance.go:369-375 updateUnavailableOfferingsCache)
         for err in result.errors:

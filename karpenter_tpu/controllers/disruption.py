@@ -41,7 +41,9 @@ from ..catalog.instancetype import InstanceType
 from ..cloud.fake import CloudError
 from ..cloud.provider import CloudProvider, InsufficientCapacityError
 from ..ops.classpack import solve_classpack
-from ..ops.constraints import LEVEL_REQUIRED_ONLY, lower_pods
+from ..ops.constraints import (LEVEL_REQUIRED_ONLY,
+                               find_batch_topology_violations, lower_pods,
+                               make_zone_feasibility)
 from ..ops.ffd import PackingResult
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
@@ -212,7 +214,10 @@ class DisruptionController:
                           if n.name not in exclude_names and n.zone})
         pods = lower_pods(pods, nodes=self.cluster.nodes.values(),
                           option_zones=zones, exclude_nodes=exclude_names,
-                          level=LEVEL_REQUIRED_ONLY)
+                          level=LEVEL_REQUIRED_ONLY,
+                          zone_feasible=make_zone_feasibility(
+                              catalog, self.cluster.nodes.values(),
+                              exclude_nodes=exclude_names))
         problem = tensorize(pods, catalog, pools)
         node_list, alloc, used, compat = self.cluster.tensorize_nodes(
             problem.class_reps, problem.axes, exclude=exclude_names)
@@ -226,6 +231,13 @@ class DisruptionController:
             existing_alloc=alloc if len(node_list) else None,
             existing_used=used if len(node_list) else None,
             existing_compat=compat if len(node_list) else None)
+        # intra-batch anti-affinity/spread the masks can't express: a
+        # violated placement disqualifies the whole action (the reference's
+        # simulation would simply fail to schedule the pod), so count the
+        # violating pods as unschedulable rather than executing a bad bind
+        violations = find_batch_topology_violations(problem, result, node_list)
+        if violations:
+            result.unschedulable = sorted(set(result.unschedulable) | violations)
         return problem, result, node_list
 
     # ------------------------------------------------------------------

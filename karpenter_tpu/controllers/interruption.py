@@ -30,6 +30,7 @@ from ..cloud.queue import (FakeQueue, Message, NOOP, ParsedEvent,
                            REBALANCE_RECOMMENDATION, SCHEDULED_CHANGE,
                            SPOT_INTERRUPTION, STATE_CHANGE, parse_event)
 from ..state.cluster import Cluster
+from ..utils import metrics
 from .termination import TerminationController
 
 log = logging.getLogger("karpenter_tpu.interruption")
@@ -72,14 +73,21 @@ class InterruptionController:
             if not messages:
                 break
             out.received += len(messages)
+            now = self.clock()
+            for msg in messages:
+                # message-age latency histogram (interruption/metrics.go:53)
+                metrics.interruption_message_latency().observe(
+                    max(0.0, now - msg.sent_at))
             # instance-id → (node, claim) map built once per batch
             # (makeNodeClaimInstanceIDMap, controller.go:94-101)
             by_id = self._instance_map()
             for msg in messages:
                 event = parse_event(msg.body)
+                metrics.interruption_received().inc({"message_type": event.kind})
                 if self._handle(event, by_id, out):
                     self.queue.delete(msg.receipt)
                     out.deleted_messages += 1
+                    metrics.interruption_deleted().inc()
         return out
 
     def _instance_map(self) -> Dict[str, Tuple[Optional[Node], Optional[NodeClaim]]]:

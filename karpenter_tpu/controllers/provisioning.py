@@ -27,10 +27,12 @@ from ..api.requirements import IN, Requirement, Requirements
 from ..api.resources import PODS, ResourceList
 from ..cloud.provider import CloudProvider, InsufficientCapacityError
 from ..ops.constraints import (MAX_LEVEL, find_batch_topology_violations,
-                               has_soft_constraints, lower_pods)
+                               has_soft_constraints, lower_pods,
+                               make_zone_feasibility)
 from ..ops.ffd import NodeDecision, PackingResult, solve_ffd
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
+from ..utils import metrics
 
 log = logging.getLogger("karpenter_tpu.provisioning")
 
@@ -130,11 +132,13 @@ class Provisioner:
         zones = sorted(set(zone_rank) | {n.zone for n in self.cluster.nodes.values()
                                          if n.zone})
         soft = has_soft_constraints(pods)
+        zone_feasible = make_zone_feasibility(catalog,
+                                              self.cluster.nodes.values())
         best = None
         for level in range(MAX_LEVEL + 1):
             lowered = lower_pods(pods, nodes=self.cluster.nodes.values(),
                                  option_zones=zones, zone_rank=zone_rank,
-                                 level=level)
+                                 level=level, zone_feasible=zone_feasible)
             problem = tensorize(lowered, catalog, pools)
             if schedule_on_existing and self.cluster.nodes:
                 node_list, alloc, used, compat = self.cluster.tensorize_nodes(
@@ -150,8 +154,9 @@ class Provisioner:
                 best = (problem, result)
             if not result.unschedulable or not soft:
                 break
-            log.info("relaxing soft constraints to level %d (%d unschedulable)",
-                     level + 1, len(result.unschedulable))
+            if level < MAX_LEVEL:
+                log.info("relaxing soft constraints to level %d (%d unschedulable)",
+                         level + 1, len(result.unschedulable))
         return best
 
     def provision(self, pods: Optional[Sequence[Pod]] = None,
@@ -188,6 +193,7 @@ class Provisioner:
             out.unschedulable.extend(retry.unschedulable)
             out.failed_launches.extend(retry.failed_launches)
             out.stranded = retry.stranded
+        metrics.pods_unschedulable().set(len(out.unschedulable))
         return out
 
     def _provision_once(self, pods: Optional[Sequence[Pod]] = None) -> ProvisioningResult:
@@ -249,4 +255,10 @@ class Provisioner:
 
         out.unschedulable.extend(orig(problem.pods[i])
                                  for i in packing.unschedulable)
+        # scheduling-duration observability (karpenter_provisioner_* families,
+        # metrics.md:146-149); the unschedulable gauge is set once per
+        # provision() from the aggregated result, not per sub-round
+        metrics.scheduling_duration().observe(out.solve_seconds)
+        for claim in out.launched:
+            metrics.nodeclaims_created().inc({"nodepool": claim.nodepool})
         return out

@@ -195,29 +195,72 @@ class _Rewrites:
         return out
 
 
-def eligible_zones(pod: Pod, zones: Sequence[str]) -> List[str]:
-    """Zones the pod's own required constraints allow."""
+def _eligible_domains(pod: Pod, key: str, domains: Sequence[str]) -> List[str]:
+    """Domains (zones / capacity types / …) the pod's own required
+    constraints allow for label `key`."""
     out = []
     branches = pod.scheduling_requirements()
-    for z in zones:
+    for d in domains:
         for b in branches:
-            r = b.get(wk.ZONE)
-            if r is None or r.has(z):
-                out.append(z)
+            r = b.get(key)
+            if r is None or r.has(d):
+                out.append(d)
                 break
     return out
+
+
+def eligible_zones(pod: Pod, zones: Sequence[str]) -> List[str]:
+    return _eligible_domains(pod, wk.ZONE, zones)
+
+
+def make_zone_feasibility(catalog: Sequence = (), nodes: Iterable[Node] = (),
+                          exclude_nodes: Sequence[str] = ()):
+    """Build a pod → {zones it can actually land in} predicate: zones with an
+    available offering on a compatible instance type, or a compatible live
+    node.  Without this, spread assignment only consults the pod's own zone
+    requirement and can pin a type-pinned pod into a zone its instance type
+    is never offered in (a false unschedulable the reference's per-pod
+    simulator cannot produce)."""
+    from ..api.taints import tolerates_all
+    excl = set(exclude_nodes)
+    node_list = [n for n in nodes
+                 if n.name not in excl and not n.marked_for_deletion and n.zone]
+    type_zones = []
+    for it in catalog:
+        avail = {o.zone for o in it.offerings if o.available}
+        if avail:
+            type_zones.append((it, avail))
+
+    def feasible(pod: Pod) -> Set[str]:
+        zones: Set[str] = set()
+        branches = pod.scheduling_requirements()
+        for it, avail in type_zones:
+            if avail <= zones:
+                continue
+            if not pod.requests.fits(it.allocatable):
+                continue
+            for b in branches:
+                allow = [k for k in b if k not in it.requirements]
+                if b.compatible(it.requirements, allow_undefined=allow):
+                    zones |= avail
+                    break
+        for n in node_list:
+            if n.zone in zones:
+                continue
+            if not tolerates_all(pod.tolerations, n.taints):
+                continue
+            labels = dict(n.labels)
+            labels.setdefault(wk.HOSTNAME, n.name)
+            provided = Requirements.from_labels(labels)
+            if any(b.compatible(provided) for b in branches):
+                zones.add(n.zone)
+        return zones
+
+    return feasible
 
 
 def _eligible_captypes(pod: Pod, captypes: Sequence[str]) -> List[str]:
-    out = []
-    branches = pod.scheduling_requirements()
-    for ct in captypes:
-        for b in branches:
-            r = b.get(wk.CAPACITY_TYPE)
-            if r is None or r.has(ct):
-                out.append(ct)
-                break
-    return out
+    return _eligible_domains(pod, wk.CAPACITY_TYPE, captypes)
 
 
 def lower_pods(pods: Sequence[Pod],
@@ -227,7 +270,8 @@ def lower_pods(pods: Sequence[Pod],
                                                  wk.CAPACITY_TYPE_SPOT),
                zone_rank: Optional[Mapping[str, float]] = None,
                exclude_nodes: Sequence[str] = (),
-               level: int = LEVEL_ALL_SOFT) -> List[Pod]:
+               level: int = LEVEL_ALL_SOFT,
+               zone_feasible=None) -> List[Pod]:
     """Lower zone/capacity-type topology constraints into pod requirement
     rewrites (see module docstring).  Returns a pod list of the same length
     and order; constrained pods are shallow copies with extra requirement
@@ -271,7 +315,18 @@ def lower_pods(pods: Sequence[Pod],
     for g in spreads.values():
         c, ns = g.constraint, g.namespace
         if c.topology_key == wk.ZONE:
-            elig = {i: eligible_zones(pods[i], option_zones) for i in g.members}
+            elig = {}
+            for i in g.members:
+                zs = eligible_zones(pods[i], option_zones)
+                if zone_feasible is not None:
+                    # restrict to zones the pod can actually land in; fall
+                    # back to the unfiltered set when nothing intersects so
+                    # the worst case stays the old (relaxable) behavior
+                    feas = zone_feasible(pods[i])
+                    inter = [z for z in zs if z in feas]
+                    if inter:
+                        zs = inter
+                elig[i] = zs
             dom_of = lambda bp: bp.zone
             key = wk.ZONE
         else:

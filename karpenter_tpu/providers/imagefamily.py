@@ -1,0 +1,218 @@
+"""Image-family resolver + per-family bootstrap userdata.
+
+Re-implements /root/reference/pkg/providers/amifamily/:
+  * image resolution — explicit selector terms, else the family's published
+    parameter-store path for the control-plane version
+    (`Provider.Get` ami.go:116-136, SSM paths in al2.go/bottlerocket.go);
+  * newest-image-per-architecture mapping of images → compatible instance
+    types (`MapToInstanceTypes` ami.go:92);
+  * `Resolver.resolve` — group a launch's instance types by image and
+    produce per-group LaunchSpecs with generated bootstrap userdata
+    (resolver.go:118-177);
+  * bootstrap generators per family: the `script` family merges custom
+    userdata as MIME multipart ahead of the bootstrap script
+    (bootstrap/eksbootstrap.go:40-123), the `config` family merges TOML-style
+    key=value settings (bottlerocket.go), `custom` passes userdata through
+    untouched (custom.go).
+"""
+
+from __future__ import annotations
+
+import email
+from dataclasses import dataclass, field
+from email.mime.multipart import MIMEMultipart
+from email.mime.text import MIMEText
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as wk
+from ..api.objects import KubeletConfiguration, NodeClass
+from ..catalog.instancetype import InstanceType
+from ..cloud.fake import CloudError, ImageInfo
+from . import matches_selector
+from .version import VersionProvider
+
+FAMILIES = ("standard", "config", "custom")
+# published parameter paths per (family, arch) — SSM path analog
+# (al2.go: /aws/service/eks/optimized-ami/$version/amazon-linux-2/...)
+PARAM_PATH = "/karpenter-tpu/images/{family}/{version}/{arch}/latest"
+
+
+@dataclass
+class LaunchSpec:
+    """One resolved (image × userdata × instance-type-group) launch shape —
+    the reference's amifamily.LaunchTemplate options (resolver.go:118-177)."""
+    image: ImageInfo
+    user_data: str
+    instance_types: List[InstanceType]
+    security_group_ids: Tuple[str, ...] = ()
+    instance_profile: str = ""
+    block_device_gib: int = 20
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap userdata generation (bootstrap/ package analog)
+# ---------------------------------------------------------------------------
+
+def _bootstrap_script(cluster_name: str, endpoint: str, labels: Dict[str, str],
+                      taints: Sequence, kubelet: Optional[KubeletConfiguration],
+                      max_pods: Optional[int]) -> str:
+    """The family's node-join script (eksbootstrap.go bootstrap flags)."""
+    args = [f"--cluster {cluster_name}", f"--endpoint {endpoint}"]
+    if labels:
+        kv = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        args.append(f"--node-labels {kv}")
+    if taints:
+        ts = ",".join(f"{t.key}={t.value}:{t.effect}" for t in taints)
+        args.append(f"--register-with-taints {ts}")
+    if max_pods is not None:
+        args.append(f"--max-pods {max_pods}")
+    if kubelet is not None and kubelet.cluster_dns:
+        args.append(f"--cluster-dns {kubelet.cluster_dns}")
+    joined = " \\\n  ".join(args)
+    return f"#!/bin/bash\nset -euo pipefail\n/opt/node/bootstrap.sh \\\n  {joined}\n"
+
+
+def merge_mime(custom: str, bootstrap: str) -> str:
+    """MIME-multipart merge: custom part(s) first, bootstrap last, so user
+    hooks run before node join (eksbootstrap.go:40-123 mergeCustomUserData)."""
+    mm = MIMEMultipart("mixed", boundary="//KARPENTER-TPU//")
+    parts: List[Tuple[str, str]] = []
+    if custom.strip():
+        head = "\n".join(custom.splitlines()[:3])
+        if "MIME-Version" in head or "Content-Type: multipart" in head:
+            msg = email.message_from_string(custom)
+            for part in msg.walk():
+                if part.get_content_maintype() == "multipart":
+                    continue
+                parts.append((part.get_content_type(),
+                              part.get_payload(decode=False)))
+        else:
+            parts.append(("text/x-shellscript", custom))
+    parts.append(("text/x-shellscript", bootstrap))
+    for ctype, payload in parts:
+        sub = MIMEText(payload, ctype.split("/", 1)[1])  # 7bit, human-readable
+        sub.replace_header("Content-Type", f'{ctype}; charset="us-ascii"')
+        mm.attach(sub)
+    return mm.as_string()
+
+
+def merge_config(custom: str, settings: Dict[str, str]) -> str:
+    """TOML-style `key = "value"` merge where generated settings win on
+    conflict (bottlerocket.go userdata merge)."""
+    out: Dict[str, str] = {}
+    for line in custom.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        k, v = line.split("=", 1)
+        out[k.strip()] = v.strip().strip('"')
+    out.update(settings)
+    return "\n".join(f'{k} = "{v}"' for k, v in sorted(out.items())) + "\n"
+
+
+def generate_user_data(family: str, cluster_name: str, endpoint: str,
+                       custom: str = "", labels: Optional[Dict[str, str]] = None,
+                       taints: Sequence = (), kubelet=None,
+                       max_pods: Optional[int] = None) -> str:
+    if family == "custom":
+        return custom  # verbatim; operator owns the whole bootstrap (custom.go)
+    if family == "config":
+        settings = {"cluster.name": cluster_name, "cluster.endpoint": endpoint}
+        for k, v in sorted((labels or {}).items()):
+            settings[f"node.labels.{k}"] = v
+        for t in taints:
+            settings[f"node.taints.{t.key}"] = f"{t.value}:{t.effect}"
+        if max_pods is not None:
+            settings["node.max-pods"] = str(max_pods)
+        return merge_config(custom, settings)
+    script = _bootstrap_script(cluster_name, endpoint, labels or {}, taints,
+                               kubelet, max_pods)
+    return merge_mime(custom, script)
+
+
+# ---------------------------------------------------------------------------
+# Image resolution
+# ---------------------------------------------------------------------------
+
+class ImageProvider:
+    """Resolves a nodeclass to concrete images (ami.go Provider.Get:116-136)."""
+
+    def __init__(self, cloud, params, version_provider: VersionProvider):
+        self.cloud = cloud
+        self.params = params
+        self.version_provider = version_provider
+
+    def get(self, nodeclass: NodeClass, archs: Sequence[str] = ("amd64", "arm64")
+            ) -> List[ImageInfo]:
+        if nodeclass.image_selector:
+            images = [i for i in self.cloud.describe_images()
+                      if matches_selector(i.id, i.tags, nodeclass.image_selector,
+                                          obj_name=i.name) and not i.deprecated]
+            return sorted(images, key=lambda i: (-i.creation_ts, i.id))
+        version = self.version_provider.get()
+        out = []
+        for arch in archs:
+            path = PARAM_PATH.format(family=nodeclass.image_family,
+                                     version=version, arch=arch)
+            try:
+                image_id = self.params.get_parameter(path)
+            except CloudError:
+                continue
+            found = self.cloud.describe_images(ids=[image_id])
+            out.extend(i for i in found if not i.deprecated)
+        return sorted(out, key=lambda i: (-i.creation_ts, i.id))
+
+
+def map_to_instance_types(images: Sequence[ImageInfo],
+                          instance_types: Sequence[InstanceType]
+                          ) -> Dict[str, List[InstanceType]]:
+    """image id → compatible instance types; newest image per architecture
+    wins (ami.go MapToInstanceTypes:92)."""
+    newest_per_arch: Dict[str, ImageInfo] = {}
+    for img in images:  # images arrive newest-first
+        newest_per_arch.setdefault(img.architecture, img)
+    out: Dict[str, List[InstanceType]] = {}
+    for it in instance_types:
+        arch_req = it.requirements.get(wk.ARCH)
+        for arch, img in newest_per_arch.items():
+            if arch_req is None or arch_req.has(arch):
+                out.setdefault(img.id, []).append(it)
+                break
+    return out
+
+
+class Resolver:
+    """amifamily.Resolver (resolver.go:118-177): nodeclass + claim context →
+    LaunchSpecs grouped by image."""
+
+    def __init__(self, image_provider: ImageProvider, cluster_name: str,
+                 endpoint: str):
+        self.image_provider = image_provider
+        self.cluster_name = cluster_name
+        self.endpoint = endpoint
+
+    def resolve(self, nodeclass: NodeClass, instance_types: Sequence[InstanceType],
+                labels: Optional[Dict[str, str]] = None, taints: Sequence = (),
+                kubelet=None, max_pods: Optional[int] = None,
+                security_group_ids: Tuple[str, ...] = (),
+                instance_profile: str = "") -> List[LaunchSpec]:
+        images = self.image_provider.get(nodeclass)
+        if not images:
+            raise CloudError("ImageNotFound",
+                             f"no images for family {nodeclass.image_family}")
+        by_image = map_to_instance_types(images, instance_types)
+        img_index = {i.id: i for i in images}
+        specs = []
+        for image_id, its in by_image.items():
+            user_data = generate_user_data(
+                nodeclass.image_family, self.cluster_name, self.endpoint,
+                custom=nodeclass.user_data, labels=labels, taints=taints,
+                kubelet=kubelet, max_pods=max_pods)
+            specs.append(LaunchSpec(
+                image=img_index[image_id], user_data=user_data,
+                instance_types=its, security_group_ids=security_group_ids,
+                instance_profile=instance_profile,
+                block_device_gib=nodeclass.block_device_gib,
+                tags=dict(nodeclass.tags)))
+        return specs

@@ -200,7 +200,12 @@ class Cluster:
             req = n.requested()
             req[PODS] = len(n.pods)
             used[e] = req.to_vector(axes, DEFAULT_SCALES, round_up=True)
-            provided = Requirements.from_labels(n.labels)
+            node_labels = dict(n.labels)
+            # hostname defaults to the node name so hostname-NotIn lowerings
+            # (anti-affinity) bind even for externally-seeded nodes that never
+            # got the label from register_nodeclaim
+            node_labels.setdefault(wk.HOSTNAME, n.name)
+            provided = Requirements.from_labels(node_labels)
             for ci, rep in enumerate(pod_classes):
                 if not tolerates_all(rep.tolerations, n.taints):
                     continue

@@ -1,0 +1,296 @@
+"""Prometheus-style metrics registry.
+
+The reference exposes ~60 metric families via controller-runtime's registry
+(/root/reference/website/content/en/docs/reference/metrics.md:30-195; in-tree
+families at pkg/controllers/interruption/metrics.go:36-62,
+pkg/providers/instancetype/metrics.go:35-46, pkg/providers/pricing/metrics.go:37,
+pkg/batcher/metrics.go:40-47).  This module is a dependency-free equivalent:
+Counter/Gauge/Histogram with label vectors and the text exposition format, so
+the operator can serve a /metrics endpoint with parity-named families.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+LabelKV = Tuple[Tuple[str, str], ...]
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _labels_key(label_names: Sequence[str], values: Dict[str, str]) -> LabelKV:
+    missing = set(label_names) - set(values)
+    extra = set(values) - set(label_names)
+    if missing or extra:
+        raise ValueError(f"label mismatch: missing={missing} extra={extra}")
+    return tuple((k, str(values[k])) for k in label_names)
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Optional[Dict[str, str]]) -> LabelKV:
+        return _labels_key(self.label_names, labels or {})
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._values: Dict[LabelKV, float] = {}
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, by: float = 1.0):
+        if by < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def samples(self) -> Iterable[Tuple[str, LabelKV, float]]:
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._values: Dict[LabelKV, float] = {}
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def add(self, by: float, labels: Optional[Dict[str, str]] = None):
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def delete(self, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[LabelKV, List[int]] = {}
+        self._sums: Dict[LabelKV, float] = {}
+        self._totals: Dict[LabelKV, int] = {}
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None):
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = bisect.bisect_left(self.buckets, value)
+            if idx < len(counts):
+                counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self._totals.get(self._key(labels), 0)
+
+    def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._sums.get(self._key(labels), 0.0)
+
+    def quantile(self, q: float, labels: Optional[Dict[str, str]] = None) -> float:
+        """Approximate quantile from bucket midpoints (observability aid)."""
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            total = self._totals.get(key, 0)
+        if not counts or total == 0:
+            return math.nan
+        target = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                return self.buckets[i]
+        return self.buckets[-1]
+
+    def samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                cum = 0
+                for b, c in zip(self.buckets, counts):
+                    cum += c
+                    out.append((f"{self.name}_bucket",
+                                key + (("le", repr(b)),), cum))
+                out.append((f"{self.name}_bucket", key + (("le", "+Inf"),),
+                            self._totals[key]))
+                out.append((f"{self.name}_sum", key, self._sums[key]))
+                out.append((f"{self.name}_count", key, self._totals[key]))
+        return out
+
+
+class Registry:
+    """A named collection of metric families with text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric) or \
+                        existing.label_names != metric.label_names:
+                    raise ValueError(f"metric {metric.name} re-registered "
+                                     "with a different schema")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_text="", labels=()) -> Counter:
+        return self._register(Counter(name, help_text, labels))
+
+    def gauge(self, name, help_text="", labels=()) -> Gauge:
+        return self._register(Gauge(name, help_text, labels))
+
+    def histogram(self, name, help_text="", labels=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_text, labels, buckets))
+
+    def get(self, name) -> Optional[_Metric]:
+        return self._metrics.get(name)
+
+    def reset(self):
+        """Drop all families (per-suite test reset — the reference resets its
+        registry between suites, pkg/test/environment.go:72-176)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda m: m.name):
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, labelkv, value in m.samples():
+                if labelkv:
+                    lbl = ",".join(f'{k}="{v}"' for k, v in labelkv)
+                    lines.append(f"{name}{{{lbl}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"
+
+
+# Process-default registry + the parity-named families used across the
+# framework (names follow metrics.md; subsystem prefix karpenter_).
+REGISTRY = Registry()
+
+
+def scheduling_duration() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_provisioner_scheduling_duration_seconds",
+        "Duration of one scheduling solve.")
+
+
+def simulation_duration() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_provisioner_scheduling_simulation_duration_seconds",
+        "Duration of one consolidation simulation solve.")
+
+
+def batch_size(name: str) -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_cloudprovider_batcher_batch_size",
+        "Requests per batch window.", labels=("batcher",),
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000))
+
+
+def batch_window_duration() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_cloudprovider_batcher_batch_time_seconds",
+        "Batch window open duration.", labels=("batcher",))
+
+
+def interruption_received() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_interruption_received_messages",
+        "Interruption queue messages received.", labels=("message_type",))
+
+
+def interruption_deleted() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_interruption_deleted_messages",
+        "Interruption queue messages deleted.")
+
+
+def interruption_message_latency() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_interruption_message_latency_time_seconds",
+        "Age of interruption messages at processing time.",
+        buckets=(1, 5, 10, 30, 60, 120, 300, 600))
+
+
+def instance_type_cpu() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_cloudprovider_instance_type_cpu_cores",
+        "VCPUs per instance type.", labels=("instance_type",))
+
+
+def instance_type_memory() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_cloudprovider_instance_type_memory_bytes",
+        "Memory per instance type.", labels=("instance_type",))
+
+
+def instance_price_estimate() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_cloudprovider_instance_type_price_estimate",
+        "Hourly price estimate per offering.",
+        labels=("instance_type", "capacity_type", "zone"))
+
+
+def nodeclaims_created() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_nodeclaims_created",
+        "NodeClaims launched.", labels=("nodepool",))
+
+
+def nodeclaims_terminated() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_nodeclaims_terminated",
+        "NodeClaims terminated.", labels=("nodepool", "reason"))
+
+
+def disruption_actions() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_disruption_actions_performed",
+        "Disruption actions executed.", labels=("action", "method"))
+
+
+def pods_unschedulable() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_provisioner_pods_unschedulable",
+        "Pods the last solve could not place.")

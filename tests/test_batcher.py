@@ -1,0 +1,216 @@
+"""Batcher behavior tests — deterministic analogs of the reference's
+pkg/batcher/*_test.go suites."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.cloud.batcher import (
+    BatchedCloud,
+    Batcher,
+    CreateFleetBatcher,
+    DescribeInstancesBatcher,
+    Options,
+    TerminateInstancesBatcher,
+)
+from karpenter_tpu.cloud.fake import FakeCloud, FleetOverride
+
+
+def _concurrent(fn, args_list):
+    """Run fn(*args) from N threads; return results in call order."""
+    results = [None] * len(args_list)
+    errors = [None] * len(args_list)
+
+    def run(i, args):
+        try:
+            results[i] = fn(*args)
+        except BaseException as e:  # re-raised by callers that care
+            errors[i] = e
+
+    threads = [threading.Thread(target=run, args=(i, a))
+               for i, a in enumerate(args_list)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    return results, errors
+
+
+def make_batcher(executor, idle=0.03, max_timeout=0.5, max_items=100,
+                 hasher=lambda r: "all"):
+    return Batcher(Options(name="test", idle_timeout=idle,
+                           max_timeout=max_timeout, max_items=max_items,
+                           request_hasher=hasher, batch_executor=executor))
+
+
+class TestGenericBatcher:
+    def test_same_hash_merges_into_one_call(self):
+        calls = []
+
+        def execute(reqs):
+            calls.append(list(reqs))
+            return [r * 10 for r in reqs]
+
+        b = make_batcher(execute)
+        results, errors = _concurrent(b.add, [(1,), (2,), (3,)])
+        assert errors == [None, None, None]
+        assert sorted(results) == [10, 20, 30]
+        assert len(calls) == 1 and sorted(calls[0]) == [1, 2, 3]
+
+    def test_each_caller_gets_own_result(self):
+        b = make_batcher(lambda reqs: [r + 100 for r in reqs])
+        results, _ = _concurrent(b.add, [(i,) for i in range(20)])
+        assert results == [i + 100 for i in range(20)]
+
+    def test_distinct_hashes_batch_separately(self):
+        calls = []
+
+        def execute(reqs):
+            calls.append(list(reqs))
+            return list(reqs)
+
+        b = make_batcher(execute, hasher=lambda r: r % 2)
+        _concurrent(b.add, [(i,) for i in range(6)])
+        assert len(calls) == 2
+        assert sorted(len(c) for c in calls) == [3, 3]
+
+    def test_max_items_closes_window_immediately(self):
+        calls = []
+
+        def execute(reqs):
+            calls.append(list(reqs))
+            return list(reqs)
+
+        b = make_batcher(execute, idle=5.0, max_timeout=5.0, max_items=4)
+        t0 = time.monotonic()
+        results, errors = _concurrent(b.add, [(i,) for i in range(4)])
+        assert time.monotonic() - t0 < 2.0  # did not wait for the idle window
+        assert errors == [None] * 4 and len(calls) == 1
+
+    def test_max_timeout_bounds_continuous_stream(self):
+        calls = []
+
+        def execute(reqs):
+            calls.append(list(reqs))
+            return list(reqs)
+
+        # idle never reached (stream keeps arriving), max_timeout forces close
+        b = make_batcher(execute, idle=0.05, max_timeout=0.15)
+        stop = time.monotonic() + 0.4
+
+        def stream(i):
+            return b.add(i)
+
+        threads = []
+        i = 0
+        while time.monotonic() < stop:
+            t = threading.Thread(target=stream, args=(i,))
+            t.start()
+            threads.append(t)
+            i += 1
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=10)
+        assert len(calls) >= 2  # at least one forced close mid-stream
+
+    def test_executor_error_fans_back_to_all_callers(self):
+        def execute(reqs):
+            raise RuntimeError("boom")
+
+        b = make_batcher(execute)
+        _, errors = _concurrent(b.add, [(1,), (2,)])
+        assert all(isinstance(e, RuntimeError) for e in errors)
+
+    def test_result_count_mismatch_is_an_error(self):
+        b = make_batcher(lambda reqs: [1])
+        _, errors = _concurrent(b.add, [(1,), (2,)])
+        assert any(e is not None for e in errors)
+
+    def test_stats_recorded(self):
+        b = make_batcher(lambda reqs: list(reqs))
+        _concurrent(b.add, [(1,), (2,)])
+        assert b.stats.batches == 1
+        assert b.stats.requests == 2
+        assert b.stats.sizes == [2]
+        assert len(b.stats.window_durations) == 1
+
+
+def _overrides():
+    return (FleetOverride("m5.large", "zone-a", "on-demand", 0.096),)
+
+
+class TestCreateFleetBatcher:
+    def test_merges_identical_requests_into_one_fleet_call(self):
+        cloud = FakeCloud()
+        b = CreateFleetBatcher(cloud, idle=0.03)
+        results, errors = _concurrent(
+            b.create_fleet, [(_overrides(), {"k": "v"})] * 5)
+        assert errors == [None] * 5
+        assert cloud.calls["create_fleet"] == 1
+        ids = [r.instances[0].id for r in results]
+        assert len(set(ids)) == 5  # each caller got a distinct instance
+
+    def test_different_shapes_do_not_merge(self):
+        cloud = FakeCloud()
+        b = CreateFleetBatcher(cloud, idle=0.03)
+        other = (FleetOverride("c5.xlarge", "zone-b", "spot", 0.068),)
+        _concurrent(b.create_fleet,
+                    [(_overrides(), {}), (other, {})])
+        assert cloud.calls["create_fleet"] == 2
+
+    def test_shortfall_callers_get_errors_not_instances(self):
+        cloud = FakeCloud()
+        cloud.insufficient_capacity_pools.add(("on-demand", "m5.large", "zone-a"))
+        b = CreateFleetBatcher(cloud, idle=0.03)
+        results, errors = _concurrent(
+            b.create_fleet, [(_overrides(), {})] * 3)
+        assert errors == [None] * 3
+        for r in results:
+            assert r.instances == []
+            assert r.errors
+
+
+class TestDescribeTerminateBatchers:
+    def test_describe_unions_and_fans_back(self):
+        cloud = FakeCloud()
+        r = cloud.create_fleet(list(_overrides()), count=4)
+        ids = [i.id for i in r.instances]
+        cloud.calls["describe_instances"] = 0
+        b = DescribeInstancesBatcher(cloud, idle=0.03)
+        results, errors = _concurrent(
+            b.describe_instances, [(ids[:2],), (ids[2:],)])
+        assert errors == [None, None]
+        assert cloud.calls["describe_instances"] == 1
+        assert sorted(i.id for i in results[0]) == sorted(ids[:2])
+        assert sorted(i.id for i in results[1]) == sorted(ids[2:])
+
+    def test_terminate_unions(self):
+        cloud = FakeCloud()
+        r = cloud.create_fleet(list(_overrides()), count=4)
+        ids = [i.id for i in r.instances]
+        b = TerminateInstancesBatcher(cloud, idle=0.03)
+        results, errors = _concurrent(
+            b.terminate_instances, [(ids[:2],), (ids[2:],)])
+        assert errors == [None, None]
+        assert cloud.calls["terminate_instances"] == 1
+        assert sorted(results[0] + results[1]) == sorted(ids)
+        assert cloud.running() == []
+
+
+class TestBatchedCloudFacade:
+    def test_passthrough_and_batched_paths(self):
+        cloud = FakeCloud()
+        bc = BatchedCloud(cloud, idle=0.03)
+        # count>1 passes through unbatched (createfleet.go:44)
+        r = bc.create_fleet(list(_overrides()), count=3)
+        assert len(r.instances) == 3
+        # batched single-capacity path
+        results, errors = _concurrent(
+            bc.create_fleet, [(list(_overrides()),)] * 2)
+        assert errors == [None, None]
+        assert all(len(r.instances) == 1 for r in results)
+        # tag-filtered describe passes through
+        assert len(bc.describe_instances()) == 5
+        # attribute passthrough
+        assert bc.running() and hasattr(bc, "interrupt")

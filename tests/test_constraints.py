@@ -469,3 +469,59 @@ def test_schedule_anyway_enforced_at_strict_level():
     for z in zones_of(prob, result):
         zc[z] += 1
     assert max(zc.values()) - min(zc.values()) <= 1
+
+
+# ---------------------------------------------------------------------------
+# capacity-aware zone feasibility (make_zone_feasibility)
+# ---------------------------------------------------------------------------
+
+def test_zone_feasibility_restricts_to_offered_zones():
+    from karpenter_tpu.ops.constraints import make_zone_feasibility
+    catalog = [make_type("pinned.large", 8, 16, 0.40, zones=("zone-a",)),
+               make_type("b.large", 8, 16, 0.40, zones=ZONES3)]
+    feasible = make_zone_feasibility(catalog)
+    pinned = cpu_pod(node_selector={wk.INSTANCE_TYPE: "pinned.large"})
+    assert feasible(pinned) == {"zone-a"}
+    assert feasible(cpu_pod()) == set(ZONES3)
+
+
+def test_zone_feasibility_counts_compatible_live_nodes():
+    from karpenter_tpu.ops.constraints import make_zone_feasibility
+    node = Node(name="n1", zone="zone-z", capacity_type="on-demand")
+    feasible = make_zone_feasibility([], nodes=[node])
+    assert feasible(cpu_pod()) == {"zone-z"}
+    # excluded nodes don't count
+    assert make_zone_feasibility([], nodes=[node],
+                                 exclude_nodes=["n1"])(cpu_pod()) == set()
+
+
+def test_spread_with_type_pinned_pods_stays_in_offered_zone():
+    # three spread pods pinned to a type offered only in zone-a with skew
+    # headroom: assignment must not scatter them into unservable zones
+    from karpenter_tpu.ops.constraints import make_zone_feasibility
+    catalog = [make_type("pinned.large", 8, 16, 0.40, zones=("zone-a",)),
+               make_type("b.large", 8, 16, 0.40, zones=ZONES3)]
+    pods = [spread_pod(skew=3, node_selector={wk.INSTANCE_TYPE: "pinned.large"})
+            for _ in range(3)]
+    lowered = lower_pods(pods, option_zones=ZONES3,
+                         zone_feasible=make_zone_feasibility(catalog))
+    prob = tensorize(lowered, catalog, [NodePool()])
+    result = solve_classpack(prob)
+    assert not result.unschedulable
+    assert set(zones_of(prob, result)) == {"zone-a"}
+
+
+def test_provisioner_spread_pinned_type_end_to_end():
+    # end-to-end: the provisioner path wires zone feasibility automatically
+    from karpenter_tpu.cloud import CloudProvider, FakeCloud
+    catalog = [make_type("pinned.large", 8, 16, 0.40, zones=("zone-a",)),
+               make_type("b.large", 8, 16, 0.40, zones=ZONES3)]
+    provider = CloudProvider(FakeCloud(), catalog)
+    cluster = Cluster()
+    prov = Provisioner(provider, cluster, [NodePool()])
+    pods = [spread_pod(skew=3, node_selector={wk.INSTANCE_TYPE: "pinned.large"})
+            for _ in range(3)]
+    cluster.add_pods(pods)
+    res = prov.provision()
+    assert not res.unschedulable
+    assert all(c.zone == "zone-a" for c in res.launched)

@@ -1,0 +1,189 @@
+"""Image family, bootstrap userdata, and launch-template provider tests
+(reference: pkg/providers/amifamily/ + pkg/providers/launchtemplate/ suites)."""
+
+import email
+
+import pytest
+
+from karpenter_tpu.api.objects import NodeClass
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import CloudError, FakeCloud, ImageInfo
+from karpenter_tpu.cloud.services import FakeControlPlane, FakeParameterStore
+from karpenter_tpu.providers.imagefamily import (ImageProvider, LaunchSpec,
+                                                 Resolver, generate_user_data,
+                                                 map_to_instance_types,
+                                                 merge_config, merge_mime)
+from karpenter_tpu.providers.launchtemplate import (LaunchTemplateProvider,
+                                                    template_name)
+from karpenter_tpu.providers.version import VersionProvider
+
+
+@pytest.fixture
+def cloud():
+    c = FakeCloud()
+    c.images = [
+        ImageInfo("img-amd-old", "standard-1.28-amd64-v1", "amd64", 100.0),
+        ImageInfo("img-amd-new", "standard-1.28-amd64-v2", "amd64", 200.0),
+        ImageInfo("img-arm-new", "standard-1.28-arm64-v2", "arm64", 200.0),
+        ImageInfo("img-deprecated", "old", "amd64", 300.0, deprecated=True),
+    ]
+    return c
+
+
+@pytest.fixture
+def image_provider(cloud):
+    params = FakeParameterStore()
+    params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-amd-new",
+        "/karpenter-tpu/images/standard/1.28/arm64/latest": "img-arm-new",
+    }
+    vp = VersionProvider(FakeControlPlane(version="1.28"))
+    return ImageProvider(cloud, params, vp)
+
+
+class TestUserData:
+    def test_standard_family_mime_merge(self):
+        out = generate_user_data("standard", "k", "https://ep",
+                                 custom="#!/bin/bash\necho custom-hook")
+        msg = email.message_from_string(out)
+        parts = [p for p in msg.walk() if p.get_content_maintype() != "multipart"]
+        assert len(parts) == 2
+        # custom hook first, bootstrap last (eksbootstrap.go merge order)
+        assert "custom-hook" in parts[0].get_payload()
+        assert "/opt/node/bootstrap.sh" in parts[1].get_payload()
+        assert "--cluster k" in parts[1].get_payload()
+
+    def test_standard_family_passes_labels_taints_maxpods(self):
+        out = generate_user_data(
+            "standard", "k", "https://ep",
+            labels={"team": "a"}, taints=[Taint("gpu", "NoSchedule", "true")],
+            max_pods=58)
+        assert "--node-labels team=a" in out
+        assert "--register-with-taints gpu=true:NoSchedule" in out
+        assert "--max-pods 58" in out
+
+    def test_mime_custom_input_reparsed(self):
+        custom = merge_mime("echo pre", "echo ignored")
+        out = generate_user_data("standard", "k", "https://ep", custom=custom)
+        msg = email.message_from_string(out)
+        payloads = [p.get_payload() for p in msg.walk()
+                    if p.get_content_maintype() != "multipart"]
+        assert any("echo pre" in p for p in payloads)
+        assert any("/opt/node/bootstrap.sh" in p for p in payloads)
+
+    def test_config_family_merge_generated_wins(self):
+        out = generate_user_data("config", "k", "https://ep",
+                                 custom='cluster.name = "evil"\nmy.setting = "1"')
+        assert 'cluster.name = "k"' in out
+        assert 'my.setting = "1"' in out
+
+    def test_config_taints_and_labels(self):
+        out = generate_user_data(
+            "config", "k", "https://ep", labels={"a": "b"},
+            taints=[Taint("t", "NoExecute", "v")], max_pods=10)
+        assert 'node.labels.a = "b"' in out
+        assert 'node.taints.t = "v:NoExecute"' in out
+        assert 'node.max-pods = "10"' in out
+
+    def test_custom_family_verbatim(self):
+        assert generate_user_data("custom", "k", "e", custom="raw") == "raw"
+
+    def test_merge_config_parsing(self):
+        assert merge_config('a = "1"\n# comment\nbad line\n', {"b": "2"}) == \
+            'a = "1"\nb = "2"\n'
+
+
+class TestImageProvider:
+    def test_resolves_published_latest_per_arch(self, image_provider):
+        imgs = image_provider.get(NodeClass())
+        assert {i.id for i in imgs} == {"img-amd-new", "img-arm-new"}
+
+    def test_selector_overrides_published(self, image_provider):
+        imgs = image_provider.get(NodeClass(image_selector={"id": "img-amd-old"}))
+        assert [i.id for i in imgs] == ["img-amd-old"]
+
+    def test_selector_skips_deprecated(self, image_provider):
+        imgs = image_provider.get(NodeClass(image_selector={"name": "old"}))
+        assert imgs == []
+
+    def test_unknown_family_resolves_nothing(self, image_provider):
+        assert image_provider.get(NodeClass(image_family="nope")) == []
+
+    def test_map_to_instance_types_newest_per_arch(self, cloud):
+        catalog = generate_catalog(10)
+        imgs = sorted(cloud.images, key=lambda i: -i.creation_ts)
+        imgs = [i for i in imgs if not i.deprecated]
+        mapping = map_to_instance_types(imgs, catalog)
+        # generated catalog is amd64 → everything maps to the newest amd64 image
+        assert set(mapping) == {"img-amd-new"}
+        assert len(mapping["img-amd-new"]) == 10
+
+
+class TestResolver:
+    def test_resolve_groups_and_generates_userdata(self, image_provider):
+        catalog = generate_catalog(5)
+        r = Resolver(image_provider, "kc", "https://ep")
+        specs = r.resolve(NodeClass(user_data="echo hi"), catalog,
+                          labels={"l": "v"})
+        assert len(specs) == 1
+        spec = specs[0]
+        assert spec.image.id == "img-amd-new"
+        assert len(spec.instance_types) == 5
+        assert "echo hi" in spec.user_data
+        assert "--node-labels l=v" in spec.user_data
+
+    def test_resolve_no_images_raises(self, image_provider):
+        r = Resolver(image_provider, "kc", "https://ep")
+        with pytest.raises(CloudError):
+            r.resolve(NodeClass(image_family="nope"), generate_catalog(3))
+
+
+class TestLaunchTemplateProvider:
+    def _provider(self, cloud, image_provider, clock=None):
+        r = Resolver(image_provider, "kc", "https://ep")
+        return LaunchTemplateProvider(cloud, r, "kc", clock=clock)
+
+    def test_ensure_all_creates_once(self, cloud, image_provider):
+        p = self._provider(cloud, image_provider)
+        catalog = generate_catalog(4)
+        nc = NodeClass()
+        out = p.ensure_all(nc, catalog)
+        assert len(out) == 1
+        assert cloud.calls["create_launch_template"] == 1
+        assert out[0].template.image_id == "img-amd-new"
+        assert len(out[0].instance_types) == 4
+        p.ensure_all(nc, catalog)  # cached
+        assert cloud.calls["create_launch_template"] == 1
+
+    def test_different_userdata_different_template(self, cloud, image_provider):
+        p = self._provider(cloud, image_provider)
+        catalog = generate_catalog(2)
+        p.ensure_all(NodeClass(), catalog)
+        p.ensure_all(NodeClass(user_data="echo different"), catalog)
+        assert len(cloud.launch_templates) == 2
+
+    def test_invalidate_recreates_after_cloud_loss(self, cloud, image_provider):
+        p = self._provider(cloud, image_provider)
+        catalog = generate_catalog(2)
+        out = p.ensure_all(NodeClass(), catalog)
+        name = out[0].template.name
+        cloud.delete_launch_template(name)
+        p.invalidate(name)
+        p.ensure_all(NodeClass(), catalog)
+        assert name in cloud.launch_templates
+
+    def test_hydrate_cache(self, cloud, image_provider):
+        p1 = self._provider(cloud, image_provider)
+        p1.ensure_all(NodeClass(), generate_catalog(2))
+        p2 = self._provider(cloud, image_provider)
+        assert p2.hydrate_cache() == 1
+        p2.ensure_all(NodeClass(), generate_catalog(2))
+        assert cloud.calls["create_launch_template"] == 1  # warm cache, no create
+
+    def test_already_exists_race_recovers(self, cloud, image_provider):
+        p1 = self._provider(cloud, image_provider)
+        p2 = self._provider(cloud, image_provider)
+        out1 = p1.ensure_all(NodeClass(), generate_catalog(2))
+        out2 = p2.ensure_all(NodeClass(), generate_catalog(2))  # create 409s
+        assert out1[0].template.name == out2[0].template.name

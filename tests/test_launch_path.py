@@ -1,0 +1,104 @@
+"""Full L2 launch-path integration: CloudProvider.create through subnets +
+launch templates (reference: launchInstance instance.go:197-253)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import NodeClaim, NodeClass
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import (FakeCloud, ImageInfo, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.cloud.provider import CloudProvider, InsufficientCapacityError
+from karpenter_tpu.cloud.services import FakeControlPlane, FakeParameterStore
+from karpenter_tpu.providers.imagefamily import ImageProvider, Resolver
+from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.providers.version import VersionProvider
+
+
+@pytest.fixture
+def stack():
+    cloud = FakeCloud()
+    cloud.subnets = [SubnetInfo("subnet-a", "zone-a", 100, {}),
+                     SubnetInfo("subnet-b", "zone-b", 100, {})]
+    cloud.security_groups = [SecurityGroupInfo("sg-1", "nodes", {})]
+    cloud.images = [ImageInfo("img-1", "standard", "amd64", 100.0)]
+    params = FakeParameterStore()
+    params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    vp = VersionProvider(FakeControlPlane(version="1.28"))
+    subnets = SubnetProvider(cloud)
+    lts = LaunchTemplateProvider(
+        cloud, Resolver(ImageProvider(cloud, params, vp), "kc", "https://ep"),
+        "kc")
+    nc = NodeClass(status_security_groups=["sg-1"],
+                   status_instance_profile="kc_profile")
+    provider = CloudProvider(cloud, generate_catalog(12), cluster_name="kc",
+                             node_classes={"default": nc},
+                             subnets=subnets, launch_templates=lts)
+    return cloud, provider, subnets
+
+
+def test_create_uses_subnet_and_template(stack):
+    cloud, provider, subnets = stack
+    claim = provider.create(NodeClaim(nodepool="default"))
+    inst = cloud.get_instance(claim.provider_id)
+    assert inst.subnet_id in ("subnet-a", "subnet-b")
+    assert inst.image_id == "img-1"
+    assert inst.launch_template.startswith("karpenter-tpu/")
+    assert cloud.launch_templates  # template actually stored
+    # prediction settled: only the landed subnet keeps its inflight charge
+    landed, other = inst.subnet_id, \
+        ("subnet-b" if inst.subnet_id == "subnet-a" else "subnet-a")
+    assert subnets.inflight(landed) == 1
+    assert subnets.inflight(other) == 0
+
+
+def test_create_restricted_to_subnet_zones(stack):
+    cloud, provider, _ = stack
+    cloud.subnets = [SubnetInfo("subnet-a", "zone-a", 100, {})]
+    provider.subnets.reset_cache()
+    for _ in range(5):
+        claim = provider.create(NodeClaim(nodepool="default"))
+        assert claim.zone == "zone-a"
+
+
+def test_create_fails_without_subnets(stack):
+    cloud, provider, _ = stack
+    cloud.subnets = []
+    provider.subnets.reset_cache()
+    with pytest.raises(InsufficientCapacityError):
+        provider.create(NodeClaim(nodepool="default"))
+
+
+def test_create_fails_without_images(stack):
+    cloud, provider, _ = stack
+    cloud.images = []
+    from karpenter_tpu.cloud.fake import CloudError
+    with pytest.raises(CloudError):
+        provider.create(NodeClaim(nodepool="default"))
+
+
+def test_launch_template_reused_across_creates(stack):
+    cloud, provider, _ = stack
+    provider.create(NodeClaim(nodepool="default"))
+    provider.create(NodeClaim(nodepool="default"))
+    assert cloud.calls["create_launch_template"] == 1
+
+
+def test_inflight_refunded_when_launch_fails(stack):
+    cloud, provider, subnets = stack
+    cloud.next_error = RuntimeError("api down")
+    with pytest.raises(RuntimeError):
+        provider.create(NodeClaim(nodepool="default"))
+    assert subnets.inflight("subnet-a") == 0
+    assert subnets.inflight("subnet-b") == 0
+
+
+def test_inflight_refunded_when_no_image_covers(stack):
+    cloud, provider, subnets = stack
+    cloud.images = []
+    from karpenter_tpu.cloud.fake import CloudError
+    with pytest.raises(CloudError):
+        provider.create(NodeClaim(nodepool="default"))
+    assert subnets.inflight("subnet-a") == 0
+    assert subnets.inflight("subnet-b") == 0

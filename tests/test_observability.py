@@ -1,0 +1,137 @@
+"""Metrics registry, event recorder, change monitor, and utils tests
+(observability parity — SURVEY.md §5.5)."""
+
+import math
+import threading
+
+import pytest
+
+from karpenter_tpu.utils import merge_tags, parse_instance_id
+from karpenter_tpu.utils.events import ChangeMonitor, Event, Recorder
+from karpenter_tpu.utils.metrics import Registry
+
+
+class TestCounter:
+    def test_inc_and_labels(self):
+        r = Registry()
+        c = r.counter("hits", "total hits", labels=("code",))
+        c.inc({"code": "200"})
+        c.inc({"code": "200"}, by=2)
+        c.inc({"code": "500"})
+        assert c.value({"code": "200"}) == 3
+        assert c.value({"code": "500"}) == 1
+
+    def test_negative_inc_rejected(self):
+        c = Registry().counter("c")
+        with pytest.raises(ValueError):
+            c.inc(by=-1)
+
+    def test_label_mismatch_rejected(self):
+        c = Registry().counter("c", labels=("a",))
+        with pytest.raises(ValueError):
+            c.inc({"b": "x"})
+
+    def test_reregister_returns_same_family(self):
+        r = Registry()
+        assert r.counter("x", labels=("l",)) is r.counter("x", labels=("l",))
+        with pytest.raises(ValueError):
+            r.counter("x", labels=("other",))
+        with pytest.raises(ValueError):
+            r.gauge("x", labels=("l",))
+
+
+class TestGaugeHistogram:
+    def test_gauge_set_add_delete(self):
+        g = Registry().gauge("g", labels=("t",))
+        g.set(5, {"t": "a"})
+        g.add(2.5, {"t": "a"})
+        assert g.value({"t": "a"}) == 7.5
+        g.delete({"t": "a"})
+        assert g.value({"t": "a"}) == 0
+
+    def test_histogram_count_sum_quantile(self):
+        h = Registry().histogram("h", buckets=(0.1, 1, 10))
+        for v in (0.05, 0.5, 0.5, 5):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(6.05)
+        assert h.quantile(0.5) == 1
+        assert math.isnan(h.quantile(0.5, None) if h.count() == 0 else math.nan) or True
+
+    def test_histogram_empty_quantile_nan(self):
+        h = Registry().histogram("h")
+        assert math.isnan(h.quantile(0.5))
+
+
+class TestExposition:
+    def test_text_format(self):
+        r = Registry()
+        r.counter("karpenter_test_total", "help text", labels=("k",)).inc({"k": "v"})
+        r.histogram("karpenter_lat", buckets=(1, 2)).observe(1.5)
+        text = r.expose()
+        assert "# TYPE karpenter_test_total counter" in text
+        assert 'karpenter_test_total{k="v"} 1.0' in text
+        assert "karpenter_lat_count 1" in text
+        assert 'karpenter_lat_bucket{le="+Inf"} 1' in text
+
+    def test_thread_safety_smoke(self):
+        r = Registry()
+        c = r.counter("n")
+
+        def spin():
+            for _ in range(1000):
+                c.inc()
+
+        ts = [threading.Thread(target=spin) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert c.value() == 8000
+
+
+class TestRecorder:
+    def test_publish_and_query(self):
+        rec = Recorder(log=False)
+        e = Event("Node", "n1", "SpotInterrupted", "spot reclaim", "Warning")
+        assert rec.publish(e)
+        assert rec.events("SpotInterrupted") == [e]
+
+    def test_dedupe_window(self):
+        t = [0.0]
+        rec = Recorder(clock=lambda: t[0], dedupe_window=10, log=False)
+        e = Event("Node", "n1", "Unconsolidatable", "pdb")
+        assert rec.publish(e)
+        assert not rec.publish(e)          # inside window
+        t[0] = 11.0
+        assert rec.publish(e)              # window expired
+        different = Event("Node", "n2", "Unconsolidatable", "pdb")
+        assert rec.publish(different)      # different object not deduped
+
+    def test_change_monitor(self):
+        cm = ChangeMonitor()
+        assert cm.has_changed("catalog", 5)
+        assert not cm.has_changed("catalog", 5)
+        assert cm.has_changed("catalog", 6)
+
+
+class TestUtils:
+    def test_parse_instance_id(self):
+        assert parse_instance_id("aws:///us-west-2a/i-0abc123") == "i-0abc123"
+        assert parse_instance_id("karpenter-tpu:///zone-a/i-000deadbeef") == "i-000deadbeef"
+        assert parse_instance_id("i-0abc123") == "i-0abc123"
+        assert parse_instance_id("garbage") is None
+
+    def test_merge_tags(self):
+        assert merge_tags({"a": "1", "b": "1"}, {"b": "2"}, None) == \
+            {"a": "1", "b": "2"}
+
+
+class TestBatcherMetricsWiring:
+    def test_batcher_records_histograms(self):
+        from karpenter_tpu.cloud.batcher import Batcher, Options
+        from karpenter_tpu.utils import metrics as m
+        before = m.batch_size("t").count({"batcher": "probe"})
+        b = Batcher(Options(name="probe", idle_timeout=0.01, max_timeout=0.1,
+                            max_items=10, request_hasher=lambda r: 0,
+                            batch_executor=lambda reqs: list(reqs)))
+        assert b.add(1) == 1
+        assert m.batch_size("t").count({"batcher": "probe"}) == before + 1

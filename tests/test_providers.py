@@ -1,0 +1,195 @@
+"""L2 provider suite tests: pricing, subnet, securitygroup, instanceprofile,
+version (reference: pkg/providers/*/suite_test.go behaviors)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import NodeClass
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import (CloudError, FakeCloud, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.cloud.services import (FakeControlPlane, FakeIAM,
+                                          FakeParameterStore, FakePricingAPI)
+from karpenter_tpu.providers import matches_selector
+from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+from karpenter_tpu.providers.pricing import (PricingController, PricingProvider,
+                                             static_price_table)
+from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.providers.version import VersionProvider
+
+
+@pytest.fixture
+def cloud():
+    c = FakeCloud()
+    c.subnets = [
+        SubnetInfo("subnet-a1", "zone-a", 100, {"team": "infra"}),
+        SubnetInfo("subnet-a2", "zone-a", 50, {"team": "infra"}),
+        SubnetInfo("subnet-b1", "zone-b", 10, {"team": "infra"}),
+        SubnetInfo("subnet-c1", "zone-c", 200, {"team": "other"}),
+    ]
+    c.security_groups = [
+        SecurityGroupInfo("sg-1", "cluster-nodes", {"cluster": "k"}),
+        SecurityGroupInfo("sg-2", "cluster-lb", {"cluster": "k"}),
+        SecurityGroupInfo("sg-3", "unrelated", {}),
+    ]
+    return c
+
+
+class TestSelector:
+    def test_tag_id_name_wildcard(self):
+        assert matches_selector("id-1", {"a": "1"}, {"a": "1"})
+        assert not matches_selector("id-1", {"a": "1"}, {"a": "2"})
+        assert matches_selector("id-1", {}, {"id": "id-1"})
+        assert matches_selector("id-1", {}, {"name": "n"}, obj_name="n")
+        assert matches_selector("id-1", {"a": "x"}, {"a": "*"})
+        assert not matches_selector("id-1", {}, {"a": "*"})
+        assert matches_selector("id-1", {}, {})  # empty matches all
+
+
+class TestSubnetProvider:
+    def test_list_by_selector_and_zone(self, cloud):
+        p = SubnetProvider(cloud)
+        nc = NodeClass(subnet_selector={"team": "infra"})
+        assert {s.id for s in p.list(nc)} == {"subnet-a1", "subnet-a2", "subnet-b1"}
+        nc_zoned = NodeClass(subnet_selector={"team": "infra"},
+                             zone_selector=["zone-a"])
+        assert {s.id for s in p.list(nc_zoned)} == {"subnet-a1", "subnet-a2"}
+
+    def test_list_is_cached(self, cloud):
+        p = SubnetProvider(cloud)
+        nc = NodeClass(subnet_selector={"team": "infra"})
+        p.list(nc)
+        p.list(nc)
+        assert cloud.calls["describe_subnets"] == 1
+
+    def test_zonal_pick_prefers_most_free_ips(self, cloud):
+        p = SubnetProvider(cloud)
+        nc = NodeClass(subnet_selector={"team": "infra"})
+        picks = p.zonal_subnets_for_launch(nc)
+        assert picks["zone-a"].id == "subnet-a1"
+        assert picks["zone-b"].id == "subnet-b1"
+        assert "zone-c" not in picks
+
+    def test_inflight_accounting_spreads_launches(self, cloud):
+        cloud.subnets = [SubnetInfo("s1", "zone-a", 3, {}),
+                        SubnetInfo("s2", "zone-a", 2, {})]
+        p = SubnetProvider(cloud)
+        nc = NodeClass()
+        first = p.zonal_subnets_for_launch(nc, ips_per_launch=2)
+        assert first["zone-a"].id == "s1"  # 3 free vs 2
+        second = p.zonal_subnets_for_launch(nc, ips_per_launch=2)
+        assert second["zone-a"].id == "s2"  # s1 now effectively 1 free
+
+    def test_inflight_refund_on_fleet_response(self, cloud):
+        cloud.subnets = [SubnetInfo("s1", "zone-a", 10, {})]
+        p = SubnetProvider(cloud)
+        nc = NodeClass()
+        req = p.zonal_subnets_for_launch(nc, ips_per_launch=4)
+        assert p.inflight("s1") == 4
+        p.update_inflight_ips(["other-subnet"], req, ips_per_launch=4)
+        assert p.inflight("s1") == 0  # launch landed elsewhere: full refund
+        req = p.zonal_subnets_for_launch(nc, ips_per_launch=4)
+        p.update_inflight_ips(["s1"], req, ips_per_launch=4)
+        assert p.inflight("s1") == 4  # landed here: prediction stands
+
+
+class TestSecurityGroupProvider:
+    def test_list_requires_selector(self, cloud):
+        p = SecurityGroupProvider(cloud)
+        assert p.list(NodeClass()) == []
+
+    def test_list_by_tag_and_name(self, cloud):
+        p = SecurityGroupProvider(cloud)
+        by_tag = p.list(NodeClass(security_group_selector={"cluster": "k"}))
+        assert {g.id for g in by_tag} == {"sg-1", "sg-2"}
+        by_name = p.list(NodeClass(security_group_selector={"name": "cluster-lb"}))
+        assert [g.id for g in by_name] == ["sg-2"]
+        assert cloud.calls["describe_security_groups"] == 2
+        p.list(NodeClass(security_group_selector={"cluster": "k"}))
+        assert cloud.calls["describe_security_groups"] == 2  # cached
+
+
+class TestInstanceProfileProvider:
+    def test_create_idempotent_and_cached(self):
+        iam = FakeIAM()
+        p = InstanceProfileProvider(iam, cluster_name="ktpu")
+        nc = NodeClass(role="node-role")
+        name = p.create(nc)
+        assert name.startswith("ktpu_")
+        assert iam.get_instance_profile(name)["_roles"] == "node-role"
+        p.create(nc)
+        assert iam.calls["create_instance_profile"] == 1
+
+    def test_role_swap(self):
+        iam = FakeIAM()
+        clock = [0.0]
+        p = InstanceProfileProvider(iam, "ktpu", clock=lambda: clock[0])
+        name = p.create(NodeClass(role="old-role"))
+        clock[0] += 16 * 60  # expire the provider cache
+        p.create(NodeClass(role="new-role"))
+        assert iam.get_instance_profile(name)["_roles"] == "new-role"
+
+    def test_delete(self):
+        iam = FakeIAM()
+        p = InstanceProfileProvider(iam, "ktpu")
+        nc = NodeClass(role="r")
+        name = p.create(nc)
+        p.delete(nc)
+        with pytest.raises(CloudError):
+            iam.get_instance_profile(name)
+        p.delete(nc)  # idempotent
+
+
+class TestVersionProvider:
+    def test_cached(self):
+        cp = FakeControlPlane(version="1.29")
+        p = VersionProvider(cp)
+        assert p.get() == "1.29"
+        assert p.get() == "1.29"
+        assert cp.calls["server_version"] == 1
+
+
+class TestPricingProvider:
+    def _provider(self, **kw):
+        catalog = generate_catalog(20)
+        api = FakePricingAPI()
+        cloud = FakeCloud()
+        p = PricingProvider(pricing_api=api, cloud=cloud,
+                            static_fallback=static_price_table(catalog), **kw)
+        return p, api, cloud, catalog
+
+    def test_static_fallback(self):
+        p, _, _, catalog = self._provider()
+        name = catalog[0].name
+        assert p.on_demand_price(name) is not None
+        assert p.spot_price(name, "zone-a") == pytest.approx(
+            p.on_demand_price(name) * 0.30)
+
+    def test_refresh_overrides_static(self):
+        p, api, cloud, catalog = self._provider()
+        name = catalog[0].name
+        api.on_demand = {name: 9.99}
+        cloud.spot_prices = {(name, "zone-a"): 1.23}
+        assert p.update_on_demand_pricing()
+        assert p.update_spot_pricing()
+        assert p.on_demand_price(name) == 9.99
+        assert p.spot_price(name, "zone-a") == 1.23
+        assert p.spot_price(name, "zone-b") == pytest.approx(9.99 * 0.30)
+
+    def test_api_failure_keeps_stale_table(self):
+        p, api, _, catalog = self._provider()
+        name = catalog[0].name
+        api.on_demand = {name: 9.99}
+        p.update_on_demand_pricing()
+        api.next_error = CloudError("Throttled")
+        assert not p.update_on_demand_pricing()
+        assert p.on_demand_price(name) == 9.99
+
+    def test_controller_respects_interval(self):
+        clock = [0.0]
+        p, api, _, _ = self._provider(clock=lambda: clock[0])
+        ctrl = PricingController(p, interval=100, clock=lambda: clock[0])
+        assert ctrl.reconcile()
+        assert not ctrl.reconcile()  # not due yet
+        clock[0] += 101
+        assert ctrl.reconcile()
