@@ -1,0 +1,39 @@
+"""Process entry point: `python -m karpenter_tpu`.
+
+The analog of /root/reference/cmd/controller/main.go:32-73 — parse options,
+build the operator, assemble core + provider controllers, serve endpoints,
+run the manager until interrupted.  Runs against the in-memory substrate;
+a real deployment swaps the substrate handles in `Operator`.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+
+from .operator import ControllerManager, Operator, Options, build_controllers
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    options = Options.from_args(argv)
+    op = Operator(options)
+    manager = ControllerManager(op, build_controllers(op))
+    port = manager.serve_endpoints()
+    logging.info("karpenter-tpu up: cluster=%s endpoints=127.0.0.1:%s "
+                 "controllers=%s", options.cluster_name, port,
+                 sorted(manager.controllers))
+    signal.signal(signal.SIGTERM, lambda *_: manager.stop())
+    signal.signal(signal.SIGINT, lambda *_: manager.stop())
+    try:
+        manager.run()
+    finally:
+        manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

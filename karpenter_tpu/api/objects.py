@@ -244,8 +244,11 @@ class NodeClaim:
     capacity_type: str = ""
     price: float = 0.0
     launched_at: float = 0.0
+    created_at: float = field(default_factory=time.time)
     registered: bool = False
+    registered_at: float = 0.0
     initialized: bool = False
+    initialized_at: float = 0.0
     terminating: bool = False
 
     @property

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Generic, Hashable, List, Optional, Sequence, Tuple, TypeVar
 
@@ -50,11 +51,13 @@ TERMINATE_MAX_ITEMS = 500
 @dataclass
 class BatchStats:
     """Per-batcher observability (batch_size / window_duration histograms,
-    /root/reference/pkg/batcher/metrics.go:40-47)."""
+    /root/reference/pkg/batcher/metrics.go:40-47).  Bounded: only the most
+    recent windows are retained (full distributions live in the metrics
+    histograms)."""
     batches: int = 0
     requests: int = 0
-    sizes: List[int] = field(default_factory=list)
-    window_durations: List[float] = field(default_factory=list)
+    sizes: "deque" = field(default_factory=lambda: deque(maxlen=1024))
+    window_durations: "deque" = field(default_factory=lambda: deque(maxlen=1024))
 
 
 @dataclass
@@ -152,18 +155,21 @@ class Batcher(Generic[Req, Res]):
         except BaseException as e:  # fan the failure back to every caller
             results, error = None, e
         window = self.clock() - bucket.opened
-        with bucket.done:
-            bucket.results = results
-            bucket.error = error
+        # shared stats guarded by the batcher lock, not the per-bucket one —
+        # concurrent buckets flush in parallel
+        with self._lock:
             self.stats.batches += 1
             self.stats.requests += len(bucket.requests)
             self.stats.sizes.append(len(bucket.requests))
             self.stats.window_durations.append(window)
+        with bucket.done:
+            bucket.results = results
+            bucket.error = error
             bucket.done.notify_all()
         # batch_size / batch_time histograms (reference pkg/batcher/metrics.go:40-47)
         from ..utils import metrics
         labels = {"batcher": self.options.name}
-        metrics.batch_size(self.options.name).observe(len(bucket.requests), labels)
+        metrics.batch_size().observe(len(bucket.requests), labels)
         metrics.batch_window_duration().observe(window, labels)
 
 
@@ -179,7 +185,10 @@ class FleetRequest:
     tags: Tuple[Tuple[str, str], ...]
 
     def shape(self) -> Hashable:
-        return (tuple((ov.instance_type, ov.zone, ov.capacity_type, ov.price)
+        # every field that affects what gets launched must hash (the
+        # reference hashes the full fleet input, batcher DefaultHasher)
+        return (tuple((ov.instance_type, ov.zone, ov.capacity_type, ov.price,
+                       ov.subnet_id, ov.launch_template, ov.image_id)
                       for ov in self.overrides), self.tags)
 
 

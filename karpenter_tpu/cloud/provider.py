@@ -169,6 +169,15 @@ class CloudProvider:
             raise InsufficientCapacityError(
                 f"no compatible instance types for claim {claim.name}")
         nodeclass = self.node_classes.get(claim.node_class_ref)
+        if nodeclass is None and (self.subnets is not None
+                                  or self.launch_templates is not None):
+            # with the L2 path wired, a dangling nodeclass ref is a config
+            # error — launching without subnets/images would produce a
+            # misconfigured node (reference errors on nodeclass resolution,
+            # cloudprovider.go:231-241)
+            raise InsufficientCapacityError(
+                f"nodeclass {claim.node_class_ref!r} not found for claim "
+                f"{claim.name}")
         # zonal subnet choice with in-flight IP accounting
         # (/root/reference/pkg/providers/instance/instance.go:197-253 →
         #  subnet.go ZonalSubnetsForLaunch:110-147)

@@ -1,0 +1,152 @@
+"""NodeClaim lifecycle: launch → registered → initialized, with liveness GC.
+
+Re-implements karpenter-core's nodeclaim lifecycle state machine
+(SURVEY.md §2.2 "NodeClaim lifecycle"; observed in-tree via the
+registered/initialized status the AWS half consumes at
+/root/reference/pkg/cloudprovider/cloudprovider.go:307-339 and the
+`karpenter.sh/initialized` label):
+
+  * **launch** — the cloud provider fulfilled the claim (`provider_id` set);
+  * **registration** — the node's kubelet joined the cluster.  In this
+    substrate the join is signalled by `FakeCloud` instance state plus a
+    configurable join delay; a claim that never registers within
+    `registration_ttl` (15m, core's liveness default) is terminated and its
+    capacity released;
+  * **initialization** — a registered node becomes schedulable for
+    disruption purposes once its startup taints are cleared and extended
+    resources are reported; the node then carries the initialized label.
+
+The provisioner's default path registers synchronously (the fake kubelet
+joins instantly); this controller is the asynchronous path the operator
+runs, and the one the chaos/liveness tests drive.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..api import labels as wk
+from ..api.objects import Node, NodeClaim
+from ..cloud.provider import CloudProvider
+from ..state.cluster import Cluster
+from ..utils import metrics
+from ..utils.events import Event, Recorder
+
+log = logging.getLogger("karpenter_tpu.lifecycle")
+
+REGISTRATION_TTL = 15 * 60.0  # core liveness: unregistered claims die at 15m
+
+
+@dataclass
+class LifecycleResult:
+    registered: List[str] = field(default_factory=list)     # claim names
+    initialized: List[str] = field(default_factory=list)    # node names
+    liveness_terminated: List[str] = field(default_factory=list)
+
+
+class LifecycleController:
+    """Tracks launched-but-unregistered claims and un-initialized nodes."""
+
+    def __init__(self, provider: CloudProvider, cluster: Cluster,
+                 nodepools: Optional[Dict[str, object]] = None,
+                 recorder: Optional[Recorder] = None,
+                 registration_ttl: float = REGISTRATION_TTL,
+                 join_delay: float = 0.0,
+                 clock: Callable[[], float] = time.time):
+        self.provider = provider
+        self.cluster = cluster
+        self.nodepools = nodepools or {}
+        self.recorder = recorder or Recorder(log=False)
+        self.registration_ttl = registration_ttl
+        self.join_delay = join_delay  # inf == kubelet never joins (chaos)
+        self.clock = clock
+        self._pending: Dict[str, NodeClaim] = {}   # claim name → claim
+        # instance-type info for allocatable at registration
+        self._catalog = {it.name: it for it in provider.instance_types.base_catalog}
+
+    def track(self, claim: NodeClaim) -> None:
+        """Adopt a launched claim for asynchronous registration."""
+        if claim.launched and not claim.registered:
+            self._pending[claim.name] = claim
+            self.cluster.nodeclaims[claim.name] = claim
+
+    def reconcile(self) -> LifecycleResult:
+        out = LifecycleResult()
+        now = self.clock()
+        for claim in list(self._pending.values()):
+            inst = None
+            try:
+                inst = self.provider.cloud.get_instance(claim.provider_id)
+            except Exception:
+                pass
+            if inst is None or inst.state != "running":
+                # instance died before registering: claim is unrecoverable
+                self._liveness_fail(claim, "InstanceTerminated", out)
+                continue
+            if now - claim.launched_at > self.registration_ttl:
+                self._liveness_fail(claim, "RegistrationTimeout", out)
+            elif now - claim.launched_at >= self.join_delay:
+                self._register(claim, out)
+        # initialization pass over registered, un-initialized nodes
+        for node in self.cluster.nodes.values():
+            claim = self.cluster.claim_for_provider_id(node.provider_id)
+            if claim is None or not claim.registered or claim.initialized:
+                continue
+            self._try_initialize(node, claim, out)
+        return out
+
+    # ------------------------------------------------------------------
+    def _register(self, claim: NodeClaim, out: LifecycleResult) -> None:
+        it = self._catalog.get(claim.instance_type)
+        allocatable = it.allocatable if it else claim.requests
+        node = self.cluster.register_nodeclaim(
+            claim, allocatable, it.capacity if it else None, initialized=False)
+        claim.registered_at = self.clock()
+        # registration leaves startup taints in place; initialization clears
+        # them (claim was created with pool startup taints included)
+        self._pending.pop(claim.name, None)
+        out.registered.append(claim.name)
+        self.recorder.publish(Event("NodeClaim", claim.name, "Registered",
+                                    f"node {node.name} joined"))
+
+    def _try_initialize(self, node: Node, claim: NodeClaim,
+                        out: LifecycleResult) -> None:
+        """Initialized == startup taints cleared ∧ capacity reported
+        (core initialization semantics)."""
+        pool = self.nodepools.get(claim.nodepool)
+        startup_keys = {t.key for t in pool.template.startup_taints} \
+            if pool is not None else set()
+        startup_keys |= {t.key for t in node.taints
+                         if t.key.startswith("node.kubernetes.io/")}
+        present = [t for t in node.taints if t.key in startup_keys]
+        if present:
+            # the (fake) kubelet/daemons clear startup taints on this pass;
+            # initialization completes on the next one (taint clearance and
+            # readiness are separate observations in the reference too)
+            node.taints = [t for t in node.taints if t.key not in startup_keys]
+            return
+        if not node.allocatable:
+            return  # capacity not reported yet
+        claim.initialized = True
+        claim.initialized_at = self.clock()
+        node.labels[wk.NODE_INITIALIZED] = "true"
+        out.initialized.append(node.name)
+        self.recorder.publish(Event("Node", node.name, "Initialized", ""))
+
+    def _liveness_fail(self, claim: NodeClaim, reason: str,
+                       out: LifecycleResult) -> None:
+        log.warning("nodeclaim %s liveness failure: %s", claim.name, reason)
+        try:
+            self.provider.delete(claim)
+        except Exception:
+            pass
+        self.cluster.nodeclaims.pop(claim.name, None)
+        self._pending.pop(claim.name, None)
+        out.liveness_terminated.append(claim.name)
+        metrics.nodeclaims_terminated().inc(
+            {"nodepool": claim.nodepool, "reason": reason})
+        self.recorder.publish(Event("NodeClaim", claim.name, reason,
+                                    "liveness failure", type="Warning"))

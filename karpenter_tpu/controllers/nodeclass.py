@@ -1,0 +1,182 @@
+"""NodeClass controller: selector config → resolved status.
+
+Re-implements /root/reference/pkg/controllers/nodeclass/controller.go:
+  * `reconcile` (:73-99) — resolve subnets (sorted by free IPs, most first),
+    security groups, images, and the instance profile into `.status`;
+    compute the static hash annotation drift detection keys off
+    (`utils/nodeclass.HashAnnotation` via cloudprovider.go:116);
+  * `finalize` (:100-126) — deletion is blocked while NodeClaims still
+    reference the class; once unreferenced, the instance profile and this
+    cluster's launch templates are garbage-collected.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..api.objects import NodeClass
+from ..state.cluster import Cluster
+
+log = logging.getLogger("karpenter_tpu.nodeclass")
+
+REQUEUE_INTERVAL = 5 * 60.0  # controller.go requeues ~5m
+
+
+def static_hash(nodeclass: NodeClass) -> str:
+    """Hash of the launch-affecting spec fields; a change means every node
+    launched from the old spec is drifted (drift.go static drift)."""
+    payload = json.dumps({
+        "image_family": nodeclass.image_family,
+        "image_selector": sorted(nodeclass.image_selector.items()),
+        "subnet_selector": sorted(nodeclass.subnet_selector.items()),
+        "security_group_selector": sorted(nodeclass.security_group_selector.items()),
+        "zone_selector": sorted(nodeclass.zone_selector),
+        "role": nodeclass.role,
+        "user_data": nodeclass.user_data,
+        "tags": sorted(nodeclass.tags.items()),
+        "block_device_gib": nodeclass.block_device_gib,
+    }, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+@dataclass
+class NodeClassResult:
+    resolved: bool = False
+    requeue_after: float = REQUEUE_INTERVAL
+    errors: List[str] = field(default_factory=list)
+
+
+class NodeClassController:
+    def __init__(self, subnets, security_groups, images, instance_profiles,
+                 cluster: Optional[Cluster] = None,
+                 clock: Callable[[], float] = time.time):
+        self.subnets = subnets
+        self.security_groups = security_groups
+        self.images = images
+        self.instance_profiles = instance_profiles
+        self.cluster = cluster
+
+    def reconcile(self, nodeclass: NodeClass) -> NodeClassResult:
+        out = NodeClassResult()
+        subnets = self.subnets.list(nodeclass)
+        if not subnets:
+            out.errors.append("no subnets resolved")
+        # most free IPs first: the launch path prefers roomy subnets
+        # (controller.go resolveSubnets sorts by available IPs)
+        subnets = sorted(subnets, key=lambda s: (-s.available_ip_count, s.id))
+        nodeclass.status_subnets = [s.id for s in subnets]
+        nodeclass.status_zones = sorted({s.zone for s in subnets})
+
+        groups = self.security_groups.list(nodeclass)
+        if nodeclass.security_group_selector and not groups:
+            out.errors.append("no security groups resolved")
+        nodeclass.status_security_groups = sorted(g.id for g in groups)
+
+        images = self.images.get(nodeclass)
+        if not images:
+            out.errors.append("no images resolved")
+        nodeclass.status_images = [i.id for i in images]
+
+        if nodeclass.role:
+            nodeclass.status_instance_profile = \
+                self.instance_profiles.create(nodeclass, tags=nodeclass.tags)
+
+        nodeclass.hash_annotation = static_hash(nodeclass)
+        out.resolved = not out.errors
+        if out.errors:
+            log.warning("nodeclass %s unresolved: %s", nodeclass.name, out.errors)
+        return out
+
+    def finalize(self, nodeclass: NodeClass,
+                 launch_templates=None) -> bool:
+        """Deletion path: refuse while any NodeClaim references the class;
+        then GC the instance profile (+ this cluster's launch templates when
+        a provider is passed). Returns whether finalization completed."""
+        if self.cluster is not None:
+            still = [c.name for c in self.cluster.nodeclaims.values()
+                     if c.node_class_ref == nodeclass.name and not c.terminating]
+            if still:
+                log.info("nodeclass %s blocked on %d nodeclaims",
+                         nodeclass.name, len(still))
+                return False
+        if nodeclass.role:
+            self.instance_profiles.delete(nodeclass)
+            nodeclass.status_instance_profile = ""
+        if launch_templates is not None:
+            launch_templates.delete_all(nodeclass)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Admission: defaulting + validation (webhook analogs,
+# /root/reference/pkg/webhooks/webhooks.go:44-63 +
+# /root/reference/pkg/apis/v1beta1/ec2nodeclass_validation.go)
+# ---------------------------------------------------------------------------
+
+class ValidationError(ValueError):
+    pass
+
+
+def default_nodeclass(nodeclass: NodeClass) -> NodeClass:
+    """Defaulting webhook analog: fill family and block-device defaults."""
+    if not nodeclass.image_family:
+        nodeclass.image_family = "standard"
+    if nodeclass.block_device_gib <= 0:
+        nodeclass.block_device_gib = 20
+    return nodeclass
+
+
+def validate_nodeclass(nodeclass: NodeClass) -> None:
+    """Validation webhook analog (ec2nodeclass_validation.go): reject specs
+    that cannot launch."""
+    from ..providers.imagefamily import FAMILIES
+    errs = []
+    if nodeclass.image_family not in FAMILIES:
+        errs.append(f"unknown image family {nodeclass.image_family!r} "
+                    f"(want one of {FAMILIES})")
+    if nodeclass.image_family == "custom" and not nodeclass.image_selector:
+        errs.append("custom image family requires an image selector")
+    if nodeclass.image_family != "custom" and nodeclass.user_data and \
+            nodeclass.user_data.lstrip().startswith("MIME-Version") and \
+            nodeclass.image_family == "config":
+        errs.append("config family user data must be key=value settings, "
+                    "not MIME")
+    if nodeclass.block_device_gib < 1:
+        errs.append("block device must be >= 1 GiB")
+    for sel_name, sel in (("subnet_selector", nodeclass.subnet_selector),
+                          ("security_group_selector",
+                           nodeclass.security_group_selector),
+                          ("image_selector", nodeclass.image_selector)):
+        for k in sel:
+            if not k:
+                errs.append(f"{sel_name} has an empty key")
+    if errs:
+        raise ValidationError("; ".join(errs))
+
+
+def validate_nodepool(nodepool) -> None:
+    """NodePool validation analog (karpenter.sh_nodepools.yaml CEL rules):
+    restricted-domain labels, sane disruption config, weight bounds."""
+    from ..api import labels as wk
+    from ..api.requirements import Requirements
+    errs = []
+    if nodepool.weight < 0 or nodepool.weight > 100:
+        errs.append(f"weight {nodepool.weight} outside [0, 100]")
+    d = nodepool.disruption
+    if d.consolidation_policy not in ("WhenUnderutilized", "WhenEmpty"):
+        errs.append(f"unknown consolidation policy {d.consolidation_policy!r}")
+    if d.consolidation_policy == "WhenEmpty" and d.consolidate_after_s is None:
+        errs.append("WhenEmpty requires consolidate_after_s")
+    if d.expire_after_s is not None and d.expire_after_s <= 0:
+        errs.append("expire_after_s must be positive")
+    restricted = (wk.NODEPOOL, wk.NODE_INITIALIZED)
+    for k in list(nodepool.template.labels) + list(nodepool.template.requirements):
+        if k in restricted:
+            errs.append(f"label {k} is restricted")
+    if errs:
+        raise ValidationError("; ".join(errs))

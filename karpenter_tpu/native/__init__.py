@@ -1,0 +1,153 @@
+"""Native runtime components (C++ via ctypes).
+
+The reference is pure Go — compiled, native host code.  This package keeps
+the same property for the framework's host-side hot paths: a C++ FFD
+bin-packer with slot semantics identical to the JAX scan kernel
+(ops/ffd.py), used when the accelerator isn't the right tool (tiny
+interactive solves, cold-start before the first jit compile, environments
+without a TPU).  The library builds on demand with the system toolchain and
+degrades gracefully: `available()` is False where no compiler exists and
+callers fall back to the JAX/NumPy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("karpenter_tpu.native")
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "csrc", "ffd.cc")
+_LIB = os.path.join(_DIR, "_libffd.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB) or \
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.warning("native lib load failed: %s", e)
+            _build_failed = True
+            return None
+        lib.ffd_pack.restype = ctypes.c_int32
+        lib.ffd_pack.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+        return _lib
+
+
+def build(force: bool = False) -> bool:
+    """Compile csrc/ffd.cc → _libffd.so with the system toolchain."""
+    if os.path.exists(_LIB) and not force and \
+            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native build failed (%s); using JAX/NumPy paths", e)
+        return False
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def ffd_pack_native(requests: np.ndarray, compat: np.ndarray,
+                    class_ids: np.ndarray, caps: np.ndarray,
+                    alloc: np.ndarray, existing_used: Optional[np.ndarray],
+                    O: int, E: int, K: int):
+    """Raw slot-level pack (same contract as ops/ffd.ffd_pack_kernel).
+    Returns (assignment P, slot_option K, slot_used K×R, n_open)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    P, R = requests.shape
+    requests = np.ascontiguousarray(requests, np.float32)
+    compat = np.ascontiguousarray(compat, np.uint8)
+    class_ids = np.ascontiguousarray(class_ids, np.int32)
+    caps = np.ascontiguousarray(caps, np.int32)
+    alloc = np.ascontiguousarray(alloc, np.float32)
+    eu = np.ascontiguousarray(existing_used, np.float32) if E else None
+    assignment = np.empty(P, np.int32)
+    slot_option = np.empty(K, np.int32)
+    slot_used = np.zeros((K, R), np.float32)
+    n_open = lib.ffd_pack(
+        P, R, O, E, K,
+        _ptr(requests, ctypes.c_float), _ptr(compat, ctypes.c_uint8),
+        _ptr(class_ids, ctypes.c_int32), _ptr(caps, ctypes.c_int32),
+        _ptr(alloc, ctypes.c_float),
+        _ptr(eu, ctypes.c_float) if eu is not None
+        else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)),
+        _ptr(assignment, ctypes.c_int32), _ptr(slot_option, ctypes.c_int32),
+        _ptr(slot_used, ctypes.c_float))
+    if n_open < 0:
+        raise RuntimeError(f"ffd_pack returned {n_open}")
+    return assignment, slot_option, slot_used, int(n_open)
+
+
+def solve_ffd_native(problem, max_nodes: Optional[int] = None,
+                     existing_alloc: Optional[np.ndarray] = None,
+                     existing_used: Optional[np.ndarray] = None,
+                     existing_compat: Optional[np.ndarray] = None,
+                     max_alternatives: int = 60):
+    """Drop-in replacement for ops/ffd.solve_ffd running on the native core
+    instead of the JAX kernel (identical PackingResult, shared decoder)."""
+    from ..ops.ffd import PackingResult, decode_assignment
+
+    E = 0 if existing_alloc is None else len(existing_alloc)
+    ec = None
+    if E:
+        ec = existing_compat if existing_compat is not None else \
+            np.ones((problem.num_classes, E), bool)
+    requests, compat, pod_idx, class_ids = problem.expand(extra_compat=ec)
+    caps = (problem.class_node_cap if problem.class_node_cap is not None
+            else np.full(problem.num_classes, 2**30, np.int32))
+    row_caps = caps[class_ids] if len(class_ids) else np.zeros(0, np.int32)
+    P = len(requests)
+    alloc = problem.option_alloc
+    O = alloc.shape[0]
+    if E:
+        alloc = np.concatenate([alloc, existing_alloc.astype(np.float32)],
+                               axis=0)
+    if alloc.shape[0] == 0:
+        return PackingResult(nodes=[], unschedulable=[int(i) for i in pod_idx],
+                             existing_assignments={}, total_price=0.0)
+    K = max(max_nodes if max_nodes is not None else P + E, E + 1)
+    assignment, slot_option, slot_used, _ = ffd_pack_native(
+        requests, compat, class_ids, row_caps, alloc, existing_used, O, E, K)
+    return decode_assignment(problem, assignment, slot_option, slot_used,
+                             pod_idx, compat, E, O, max_alternatives)

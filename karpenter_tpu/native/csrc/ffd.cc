@@ -1,0 +1,113 @@
+// Native host-side FFD bin-packer.
+//
+// The performance-critical host fallback for the packing hot loop
+// (designs/bin-packing.md:16-43 first-fit-decreasing with per-pod cheapest
+// new node): identical slot semantics to the JAX scan kernel in
+// karpenter_tpu/ops/ffd.py (ffd_pack_kernel), so the two paths share a
+// decoder.  Used when the accelerator isn't warm, for small interactive
+// solves where kernel-launch latency dominates, and by the consolidation
+// simulator's host-side spot checks.
+//
+// Build: g++ -O3 -shared -fPIC -o _libffd.so ffd.cc (see ../build.py).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Pack P pre-sorted pod rows into at most K node slots.
+//
+// Inputs (row-major):
+//   requests   P×R  float   per-row resource demand
+//   compat     P×A  uint8   row × option feasibility, A = O + E
+//   class_ids  P    int32   contiguous per class (stable FFD sort)
+//   caps       P    int32   max pods of the row's class per node
+//   alloc      A×R  float   allocatable per option (existing appended)
+//   E existing nodes occupy slots [0, E) with option O+e and initial use
+//   existing_used E×R float (may be null when E == 0)
+//
+// Outputs:
+//   assignment  P   int32   slot per row, -1 == unschedulable
+//   slot_option K   int32   option per open slot (-1 == never opened)
+//   slot_used   K×R float   resources consumed per slot
+//
+// Returns the number of open slots, or -1 on bad arguments.
+int32_t ffd_pack(int32_t P, int32_t R, int32_t O, int32_t E, int32_t K,
+                 const float* requests, const uint8_t* compat,
+                 const int32_t* class_ids, const int32_t* caps,
+                 const float* alloc, const float* existing_used,
+                 int32_t* assignment, int32_t* slot_option,
+                 float* slot_used) {
+  const int32_t A = O + E;
+  if (P < 0 || R <= 0 || O < 0 || E < 0 || K < E) return -1;
+
+  for (int32_t k = 0; k < K; ++k) slot_option[k] = -1;
+  std::memset(slot_used, 0, sizeof(float) * (size_t)K * R);
+  int32_t n_open = E;
+  for (int32_t e = 0; e < E; ++e) {
+    slot_option[e] = O + e;
+    if (existing_used)
+      std::memcpy(slot_used + (size_t)e * R, existing_used + (size_t)e * R,
+                  sizeof(float) * R);
+  }
+
+  // per-slot count of the *current* class; classes arrive contiguously, so
+  // one counter array reset on class change implements the per-class node
+  // cap (hostname anti-affinity / spread, tensorize._node_cap)
+  std::vector<int32_t> class_count(K, 0);
+  int32_t cur_class = -2;
+
+  for (int32_t row = 0; row < P; ++row) {
+    if (class_ids[row] != cur_class) {
+      cur_class = class_ids[row];
+      std::fill(class_count.begin(), class_count.end(), 0);
+    }
+    const float* req = requests + (size_t)row * R;
+    const uint8_t* crow = compat + (size_t)row * A;
+    const int32_t cap = caps[row];
+    int32_t placed = -1;
+
+    for (int32_t k = 0; k < n_open; ++k) {
+      const int32_t oi = slot_option[k];
+      if (!crow[oi] || class_count[k] >= cap) continue;
+      const float* a = alloc + (size_t)oi * R;
+      float* u = slot_used + (size_t)k * R;
+      bool fits = true;
+      for (int32_t r = 0; r < R; ++r)
+        if (u[r] + req[r] > a[r]) { fits = false; break; }
+      if (!fits) continue;
+      for (int32_t r = 0; r < R; ++r) u[r] += req[r];
+      placed = k;
+      break;
+    }
+
+    if (placed < 0 && n_open < K) {
+      // cheapest feasible new node == lowest option index (options arrive
+      // pre-sorted by pool rank then price, tensorize.build_options)
+      for (int32_t j = 0; j < O; ++j) {
+        if (!crow[j] || cap < 1) continue;
+        const float* a = alloc + (size_t)j * R;
+        bool fits = true;
+        for (int32_t r = 0; r < R; ++r)
+          if (req[r] > a[r]) { fits = false; break; }
+        if (!fits) continue;
+        placed = n_open++;
+        slot_option[placed] = j;
+        float* u = slot_used + (size_t)placed * R;
+        for (int32_t r = 0; r < R; ++r) u[r] = req[r];
+        break;
+      }
+    }
+
+    if (placed >= 0) {
+      class_count[placed] += 1;
+      assignment[row] = placed;
+    } else {
+      assignment[row] = -1;
+    }
+  }
+  return n_open;
+}
+
+}  // extern "C"

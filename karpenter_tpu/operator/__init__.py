@@ -1,0 +1,14 @@
+"""L0 runtime: operator (DI wiring), controller manager, endpoints.
+
+Re-implements the reference's operator layer
+(/root/reference/pkg/operator/operator.go:84-195 — construct every provider
+once, wire the controller set, expose health + metrics —
+plus cmd/controller/main.go:32-73 — registration order and startup).
+"""
+
+from .operator import Operator, build_controllers
+from .options import Options
+from .manager import ControllerManager, PodBatchWindow
+
+__all__ = ["Operator", "Options", "ControllerManager", "PodBatchWindow",
+           "build_controllers"]

@@ -1,0 +1,148 @@
+"""Operator: dependency wiring for the whole framework.
+
+The analog of `operator.NewOperator` (/root/reference/pkg/operator/
+operator.go:84-195): one constructor that builds the cloud session, probes
+connectivity, resolves the cluster endpoint, and constructs all providers,
+exposing them as attributes for the controller set and tests.  The AWS
+session/IMDS/STS machinery maps to the fake-cloud substrate handles here;
+a real deployment swaps `FakeCloud` + fake services for live ones behind
+the same call surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..api.objects import NodeClass, NodePool
+from ..catalog.generate import generate_catalog
+from ..cloud.batcher import BatchedCloud
+from ..cloud.cache import UnavailableOfferings
+from ..cloud.fake import CloudError, FakeCloud
+from ..cloud.provider import CloudProvider
+from ..cloud.queue import FakeQueue
+from ..cloud.services import (FakeControlPlane, FakeIAM, FakeParameterStore,
+                              FakePricingAPI)
+from ..controllers.disruption import DisruptionController
+from ..controllers.garbagecollection import (GarbageCollectionController,
+                                             TaggingController)
+from ..controllers.interruption import InterruptionController
+from ..controllers.lifecycle import LifecycleController
+from ..controllers.nodeclass import NodeClassController
+from ..controllers.provisioning import Provisioner
+from ..controllers.termination import TerminationController
+from ..providers.imagefamily import ImageProvider, Resolver
+from ..providers.instanceprofile import InstanceProfileProvider
+from ..providers.launchtemplate import LaunchTemplateProvider
+from ..providers.pricing import (PricingController, PricingProvider,
+                                 static_price_table)
+from ..providers.securitygroup import SecurityGroupProvider
+from ..providers.subnet import SubnetProvider
+from ..providers.version import VersionProvider
+from ..state.cluster import Cluster
+from ..utils.events import Recorder
+from .options import Options
+
+log = logging.getLogger("karpenter_tpu.operator")
+
+
+class Operator:
+    """Builds the full provider graph over a cloud substrate
+    (operator.go:127-169 constructs 11 providers; same inventory here)."""
+
+    def __init__(self, options: Optional[Options] = None,
+                 cloud: Optional[FakeCloud] = None,
+                 catalog=None,
+                 control_plane: Optional[FakeControlPlane] = None,
+                 params: Optional[FakeParameterStore] = None,
+                 iam: Optional[FakeIAM] = None,
+                 pricing_api: Optional[FakePricingAPI] = None,
+                 queue: Optional[FakeQueue] = None,
+                 clock: Callable[[], float] = time.time):
+        self.options = options or Options()
+        self.clock = clock
+        self.queue = queue or (FakeQueue(clock=clock)
+                               if self.options.interruption_queue else None)
+        self.cloud = cloud or FakeCloud(clock=clock, queue=self.queue)
+        self.raw_cloud = self.cloud
+        self.batched_cloud = BatchedCloud(self.cloud)
+        self.catalog = catalog if catalog is not None else generate_catalog(600)
+        self.control_plane = control_plane or FakeControlPlane(
+            endpoint=self.options.cluster_endpoint)
+        self.params = params or FakeParameterStore()
+        self.iam = iam or FakeIAM()
+        self.pricing_api = pricing_api or FakePricingAPI()
+
+        # connectivity probe (checkEC2Connectivity operator.go:206-213)
+        try:
+            self.cloud.describe_instances()
+        except CloudError as e:
+            raise RuntimeError(f"cloud connectivity probe failed: {e}") from e
+        # cluster endpoint discovery (ResolveClusterEndpoint :215-227)
+        if not self.options.cluster_endpoint:
+            self.options.cluster_endpoint = \
+                self.control_plane.describe_cluster()["endpoint"]
+
+        self.recorder = Recorder(clock=clock)
+        self.unavailable = UnavailableOfferings(clock=clock)
+        self.subnets = SubnetProvider(self.cloud, clock=clock)
+        self.security_groups = SecurityGroupProvider(self.cloud, clock=clock)
+        self.instance_profiles = InstanceProfileProvider(
+            self.iam, self.options.cluster_name, clock=clock)
+        self.version = VersionProvider(self.control_plane, clock=clock)
+        self.images = ImageProvider(self.cloud, self.params, self.version)
+        self.resolver = Resolver(self.images, self.options.cluster_name,
+                                 self.options.cluster_endpoint)
+        self.launch_templates = LaunchTemplateProvider(
+            self.cloud, self.resolver, self.options.cluster_name, clock=clock)
+        self.launch_templates.hydrate_cache()  # launchtemplate.go:336
+        self.pricing = PricingProvider(
+            pricing_api=None if self.options.isolated_network else self.pricing_api,
+            cloud=self.cloud, static_fallback=static_price_table(self.catalog),
+            clock=clock)
+
+        self.cluster = Cluster(clock=clock)
+        self.node_classes: Dict[str, NodeClass] = {"default": NodeClass()}
+        self.nodepools: Dict[str, NodePool] = {"default": NodePool()}
+        self.cloud_provider = CloudProvider(
+            self.batched_cloud, self.catalog, unavailable=self.unavailable,
+            node_classes=self.node_classes,
+            cluster_name=self.options.cluster_name, clock=clock,
+            subnets=self.subnets, launch_templates=self.launch_templates)
+
+
+def build_controllers(op: Operator) -> Dict[str, object]:
+    """Assemble the controller set (controllers.NewControllers
+    /root/reference/pkg/controllers/controllers.go:45-65 + core registration
+    in cmd/controller/main.go:47-70). Interruption registers only when a
+    queue is configured; pricing refresh only outside isolated networks."""
+    pools = list(op.nodepools.values())
+    provisioner = Provisioner(op.cloud_provider, op.cluster, pools)
+    terminator = TerminationController(op.cloud_provider, op.cluster,
+                                       clock=op.clock)
+    out: Dict[str, object] = {
+        "provisioning": provisioner,
+        "termination": terminator,
+        "disruption": DisruptionController(
+            op.cloud_provider, op.cluster, pools,
+            terminator=terminator, clock=op.clock,
+            drift_enabled=op.options.gate("Drift")),
+        "lifecycle": LifecycleController(
+            op.cloud_provider, op.cluster, nodepools=op.nodepools,
+            recorder=op.recorder, clock=op.clock),
+        "garbagecollection": GarbageCollectionController(
+            op.cloud_provider, op.cluster, clock=op.clock),
+        "tagging": TaggingController(op.cloud_provider, op.cluster),
+        "nodeclass": NodeClassController(
+            subnets=op.subnets, security_groups=op.security_groups,
+            images=op.images, instance_profiles=op.instance_profiles,
+            cluster=op.cluster),
+    }
+    if op.queue is not None:
+        out["interruption"] = InterruptionController(
+            op.queue, op.cloud_provider, op.cluster, terminator,
+            clock=op.clock)
+    if not op.options.isolated_network:
+        out["pricing"] = PricingController(op.pricing, clock=op.clock)
+    return out

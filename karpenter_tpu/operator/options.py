@@ -1,0 +1,141 @@
+"""Flag/option system: CLI flags ⊕ env-var defaults ⊕ legacy settings.
+
+Re-implements /root/reference/pkg/operator/options/options.go:53-63 (flag
+set with env defaults) and the legacy `karpenter-global-settings` ConfigMap
+merge (`MergeSettings` options.go:97 +
+/root/reference/pkg/apis/settings/settings.go:50-98).  Precedence mirrors
+the reference: explicit CLI flag > env var > legacy settings > default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+from typing import Dict, List, Optional, Sequence
+
+ENV_PREFIX = "KARPENTER_TPU_"
+
+# Defaults cited from the reference where they exist.
+DEFAULT_VM_MEMORY_OVERHEAD = 0.075      # options.go vm-memory-overhead-percent
+DEFAULT_BATCH_IDLE = 1.0                # settings.md:17 batch-idle-duration
+DEFAULT_BATCH_MAX = 10.0                # settings.md:18 batch-max-duration
+DEFAULT_METRICS_PORT = 8000
+DEFAULT_HEALTH_PORT = 8081
+
+
+@dataclass
+class Options:
+    cluster_name: str = "default"
+    cluster_endpoint: str = "https://cluster.local"
+    isolated_network: bool = False       # isolated-vpc analog: no pricing API
+    vm_memory_overhead_percent: float = DEFAULT_VM_MEMORY_OVERHEAD
+    interruption_queue: str = ""         # empty == interruption disabled
+    reserved_enis: int = 0
+    batch_idle_duration: float = DEFAULT_BATCH_IDLE
+    batch_max_duration: float = DEFAULT_BATCH_MAX
+    metrics_port: int = DEFAULT_METRICS_PORT
+    health_port: int = DEFAULT_HEALTH_PORT
+    leader_elect: bool = False
+    feature_gates: Dict[str, bool] = field(
+        default_factory=lambda: {"Drift": True})
+    tags: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "Options":
+        """Parse CLI flags with env-var defaults (options.go AddFlags)."""
+        env = cls._env_defaults()
+        p = argparse.ArgumentParser(prog="karpenter-tpu")
+        p.add_argument("--cluster-name",
+                       default=env.get("cluster_name", "default"))
+        p.add_argument("--cluster-endpoint",
+                       default=env.get("cluster_endpoint", "https://cluster.local"))
+        p.add_argument("--isolated-network", action="store_true",
+                       default=env.get("isolated_network", False))
+        p.add_argument("--vm-memory-overhead-percent", type=float,
+                       default=env.get("vm_memory_overhead_percent",
+                                       DEFAULT_VM_MEMORY_OVERHEAD))
+        p.add_argument("--interruption-queue",
+                       default=env.get("interruption_queue", ""))
+        p.add_argument("--reserved-enis", type=int,
+                       default=env.get("reserved_enis", 0))
+        p.add_argument("--batch-idle-duration", type=float,
+                       default=env.get("batch_idle_duration", DEFAULT_BATCH_IDLE))
+        p.add_argument("--batch-max-duration", type=float,
+                       default=env.get("batch_max_duration", DEFAULT_BATCH_MAX))
+        p.add_argument("--metrics-port", type=int,
+                       default=env.get("metrics_port", DEFAULT_METRICS_PORT))
+        p.add_argument("--health-port", type=int,
+                       default=env.get("health_port", DEFAULT_HEALTH_PORT))
+        p.add_argument("--leader-elect", action="store_true",
+                       default=env.get("leader_elect", False))
+        p.add_argument("--feature-gates", default="",
+                       help="comma list Gate=true|false")
+        ns = p.parse_args(argv)
+        opts = cls(
+            cluster_name=ns.cluster_name,
+            cluster_endpoint=ns.cluster_endpoint,
+            isolated_network=ns.isolated_network,
+            vm_memory_overhead_percent=ns.vm_memory_overhead_percent,
+            interruption_queue=ns.interruption_queue,
+            reserved_enis=ns.reserved_enis,
+            batch_idle_duration=ns.batch_idle_duration,
+            batch_max_duration=ns.batch_max_duration,
+            metrics_port=ns.metrics_port,
+            health_port=ns.health_port,
+            leader_elect=ns.leader_elect,
+        )
+        for gate in filter(None, ns.feature_gates.split(",")):
+            name, _, value = gate.partition("=")
+            opts.feature_gates[name.strip()] = value.strip().lower() != "false"
+        return opts
+
+    @staticmethod
+    def _env_defaults() -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        casts = {
+            "isolated_network": lambda v: v.lower() == "true",
+            "leader_elect": lambda v: v.lower() == "true",
+            "vm_memory_overhead_percent": float,
+            "reserved_enis": int,
+            "batch_idle_duration": float,
+            "batch_max_duration": float,
+            "metrics_port": int,
+            "health_port": int,
+        }
+        for f in fields(Options):
+            raw = os.environ.get(ENV_PREFIX + f.name.upper())
+            if raw is None:
+                continue
+            out[f.name] = casts.get(f.name, str)(raw)
+        return out
+
+    def merge_settings(self, settings: Dict[str, str]) -> "Options":
+        """Fold legacy configmap-style settings in; flags/env already set on
+        self win only when they differ from the dataclass default
+        (MergeSettings options.go:97 keeps non-default flag values)."""
+        mapping = {
+            "cluster-name": ("cluster_name", str),
+            "cluster-endpoint": ("cluster_endpoint", str),
+            "isolated-network": ("isolated_network",
+                                 lambda v: v.lower() == "true"),
+            "vm-memory-overhead-percent": ("vm_memory_overhead_percent", float),
+            "interruption-queue": ("interruption_queue", str),
+            "reserved-enis": ("reserved_enis", int),
+            "batch-idle-duration": ("batch_idle_duration", float),
+            "batch-max-duration": ("batch_max_duration", float),
+        }
+        defaults = Options()
+        for key, (attr, cast) in mapping.items():
+            if key not in settings:
+                continue
+            if getattr(self, attr) != getattr(defaults, attr):
+                continue  # explicitly configured: flag/env wins
+            setattr(self, attr, cast(settings[key]))
+        for k, v in settings.items():
+            if k.startswith("tags."):
+                self.tags[k[len("tags."):]] = v
+        return self
+
+    def gate(self, name: str) -> bool:
+        return self.feature_gates.get(name, False)

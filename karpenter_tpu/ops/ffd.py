@@ -120,19 +120,41 @@ class PackingResult:
                 + len(self.existing_assignments))
 
 
+# below this many rows the native C++ packer beats a device kernel launch
+NATIVE_CUTOVER_ROWS = 256
+
+
 def solve_ffd(problem: Problem,
               max_nodes: Optional[int] = None,
               existing_alloc: Optional[np.ndarray] = None,   # E×R
               existing_used: Optional[np.ndarray] = None,    # E×R
               existing_compat: Optional[np.ndarray] = None,  # C×E bool
-              max_alternatives: int = 60) -> PackingResult:
+              max_alternatives: int = 60,
+              backend: str = "auto") -> PackingResult:
     """Host wrapper: expand classes → pad → run kernel → decode decisions.
 
     Existing cluster nodes (for provisioning against live capacity and for
     consolidation simulation) enter as pre-opened slots with price already
     paid: their allocatable/used vectors are appended as zero-price virtual
     options.
+
+    `backend`: "jax" (scan kernel), "native" (C++ packer — identical slot
+    semantics, see karpenter_tpu/native), or "auto" — native for small rows
+    where kernel-launch latency dominates, accelerator otherwise.
     """
+    if backend == "auto":
+        total_rows = int(problem.class_counts.sum()) + \
+            (0 if existing_alloc is None else len(existing_alloc))
+        if total_rows <= NATIVE_CUTOVER_ROWS:
+            from .. import native
+            if native.available():
+                backend = "native"
+    if backend == "native":
+        from .. import native
+        return native.solve_ffd_native(
+            problem, max_nodes=max_nodes, existing_alloc=existing_alloc,
+            existing_used=existing_used, existing_compat=existing_compat,
+            max_alternatives=max_alternatives)
     E = 0 if existing_alloc is None else len(existing_alloc)
     ec = None
     if E:
@@ -199,8 +221,17 @@ def solve_ffd(problem: Problem,
     assignment = np.asarray(assignment)[:P]
     slot_option = np.asarray(slot_option)
     slot_used = np.asarray(slot_used)
+    return decode_assignment(problem, assignment, slot_option, slot_used,
+                             pod_idx, compat, E, O, max_alternatives)
 
-    # decode
+
+def decode_assignment(problem: Problem, assignment: np.ndarray,
+                      slot_option: np.ndarray, slot_used: np.ndarray,
+                      pod_idx: np.ndarray, compat: np.ndarray,
+                      E: int, O: int, max_alternatives: int = 60
+                      ) -> PackingResult:
+    """Slot arrays → NodeDecisions (shared by the JAX kernel and the native
+    C++ packer, which produce identical slot layouts)."""
     slot_pods: Dict[int, List[int]] = {}
     slot_rows: Dict[int, List[int]] = {}
     unschedulable: List[int] = []

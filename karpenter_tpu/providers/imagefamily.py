@@ -136,15 +136,37 @@ def generate_user_data(family: str, cluster_name: str, endpoint: str,
 # ---------------------------------------------------------------------------
 
 class ImageProvider:
-    """Resolves a nodeclass to concrete images (ami.go Provider.Get:116-136)."""
+    """Resolves a nodeclass to concrete images (ami.go Provider.Get:116-136),
+    TTL-cached per (family, version, selector) so per-launch resolution stays
+    off the I/O path (the reference caches AMI resolution the same way)."""
 
-    def __init__(self, cloud, params, version_provider: VersionProvider):
+    IMAGE_CACHE_TTL = 60.0
+
+    def __init__(self, cloud, params, version_provider: VersionProvider,
+                 clock=None):
         self.cloud = cloud
         self.params = params
         self.version_provider = version_provider
+        from ..cloud.cache import TTLCache
+        self._cache = TTLCache(self.IMAGE_CACHE_TTL,
+                               **({"clock": clock} if clock else {}))
 
     def get(self, nodeclass: NodeClass, archs: Sequence[str] = ("amd64", "arm64")
             ) -> List[ImageInfo]:
+        key = (nodeclass.image_family, tuple(archs),
+               tuple(sorted(nodeclass.image_selector.items())))
+        cached = self._cache.get(key)
+        if cached is not None:
+            return list(cached)
+        out = self._resolve(nodeclass, archs)
+        self._cache.set(key, out)
+        return list(out)
+
+    def reset_cache(self):
+        self._cache.flush()
+
+    def _resolve(self, nodeclass: NodeClass, archs: Sequence[str]
+                 ) -> List[ImageInfo]:
         if nodeclass.image_selector:
             images = [i for i in self.cloud.describe_images()
                       if matches_selector(i.id, i.tags, nodeclass.image_selector,

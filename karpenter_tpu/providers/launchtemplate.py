@@ -72,10 +72,10 @@ class LaunchTemplateProvider:
             kubelet=kubelet, max_pods=max_pods,
             security_group_ids=security_group_ids,
             instance_profile=instance_profile)
-        return [ResolvedTemplate(self._ensure(spec), spec.instance_types)
+        return [ResolvedTemplate(self._ensure(spec, nodeclass), spec.instance_types)
                 for spec in specs]
 
-    def _ensure(self, spec: LaunchSpec) -> LaunchTemplateInfo:
+    def _ensure(self, spec: LaunchSpec, nodeclass: NodeClass) -> LaunchTemplateInfo:
         name = template_name(spec, self.cluster_name)
         cached = self._cache.get(name)
         if cached is not None:
@@ -85,7 +85,8 @@ class LaunchTemplateProvider:
             security_group_ids=tuple(spec.security_group_ids),
             block_device_gib=spec.block_device_gib,
             instance_profile=spec.instance_profile,
-            tags={**spec.tags, "karpenter.sh/cluster": self.cluster_name})
+            tags={**spec.tags, "karpenter.sh/cluster": self.cluster_name,
+                  "karpenter.sh/nodeclass": nodeclass.name})
         try:
             self.cloud.create_launch_template(lt)
         except CloudError as e:
@@ -111,11 +112,12 @@ class LaunchTemplateProvider:
         return n
 
     def delete_all(self, nodeclass: NodeClass) -> int:
-        """GC every stored template for this cluster that references an image
-        the nodeclass no longer resolves (used by nodeclass finalize)."""
+        """GC this nodeclass's stored templates (nodeclass finalize path);
+        other nodeclasses' templates in the same cluster are untouched."""
         n = 0
         for lt in self.cloud.describe_launch_templates(
-                tag_filter={"karpenter.sh/cluster": self.cluster_name}):
+                tag_filter={"karpenter.sh/cluster": self.cluster_name,
+                            "karpenter.sh/nodeclass": nodeclass.name}):
             try:
                 self.cloud.delete_launch_template(lt.name)
                 self._cache.delete(lt.name)

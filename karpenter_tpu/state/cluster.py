@@ -95,11 +95,15 @@ class Cluster:
         return node
 
     def register_nodeclaim(self, claim: NodeClaim, allocatable: ResourceList,
-                           capacity: Optional[ResourceList] = None) -> Node:
+                           capacity: Optional[ResourceList] = None,
+                           initialized: bool = True) -> Node:
         """NodeClaim → Node on (simulated) kubelet join; lifecycle per
-        SURVEY §2.2 NodeClaim lifecycle."""
+        SURVEY §2.2 NodeClaim lifecycle.  The sync provisioning path
+        registers+initializes in one step (instant fake kubelet); the async
+        LifecycleController passes initialized=False and runs the
+        initialization pass separately."""
         claim.registered = True
-        claim.initialized = True
+        claim.initialized = initialized
         self.nodeclaims[claim.name] = claim
         node = Node(
             name=f"node-{next(_names):06d}",

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -32,13 +33,15 @@ class Recorder:
     """In-memory event sink with de-duplication window (the reference's
     recorder drops repeats inside a flush interval)."""
 
+    MAX_EVENTS = 4096  # retained for inspection; bounded (a daemon runs forever)
+
     def __init__(self, clock: Callable[[], float] = time.time,
                  dedupe_window: float = 10.0, log: bool = True):
         self.clock = clock
         self.dedupe_window = dedupe_window
         self.log = log
         self._lock = threading.Lock()
-        self._events: List[Event] = []
+        self._events: "deque[Event]" = deque(maxlen=self.MAX_EVENTS)
         self._last_seen: Dict[Event, float] = {}
 
     def publish(self, event: Event) -> bool:
@@ -49,6 +52,11 @@ class Recorder:
             last = self._last_seen.get(event)
             if last is not None and now - last < self.dedupe_window:
                 return False
+            if len(self._last_seen) > 2 * self.MAX_EVENTS:
+                # prune expired dedupe entries so the map stays bounded
+                cutoff = now - self.dedupe_window
+                self._last_seen = {e: t for e, t in self._last_seen.items()
+                                   if t >= cutoff}
             self._last_seen[event] = now
             self._events.append(event)
         if self.log:

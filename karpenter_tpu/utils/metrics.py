@@ -221,7 +221,7 @@ def simulation_duration() -> Histogram:
         "Duration of one consolidation simulation solve.")
 
 
-def batch_size(name: str) -> Histogram:
+def batch_size() -> Histogram:
     return REGISTRY.histogram(
         "karpenter_cloudprovider_batcher_batch_size",
         "Requests per batch window.", labels=("batcher",),

@@ -132,7 +132,7 @@ class TestGenericBatcher:
         _concurrent(b.add, [(1,), (2,)])
         assert b.stats.batches == 1
         assert b.stats.requests == 2
-        assert b.stats.sizes == [2]
+        assert list(b.stats.sizes) == [2]
         assert len(b.stats.window_durations) == 1
 
 

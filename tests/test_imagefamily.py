@@ -187,3 +187,17 @@ class TestLaunchTemplateProvider:
         out1 = p1.ensure_all(NodeClass(), generate_catalog(2))
         out2 = p2.ensure_all(NodeClass(), generate_catalog(2))  # create 409s
         assert out1[0].template.name == out2[0].template.name
+
+
+class TestDeleteAllScoping:
+    def test_delete_all_only_touches_own_nodeclass(self, cloud, image_provider):
+        r = Resolver(image_provider, "kc", "https://ep")
+        p = LaunchTemplateProvider(cloud, r, "kc")
+        catalog = generate_catalog(2)
+        p.ensure_all(NodeClass(name="a"), catalog)
+        p.ensure_all(NodeClass(name="b", user_data="echo b"), catalog)
+        assert len(cloud.launch_templates) == 2
+        assert p.delete_all(NodeClass(name="a")) == 1
+        remaining = list(cloud.launch_templates.values())
+        assert len(remaining) == 1
+        assert remaining[0].tags["karpenter.sh/nodeclass"] == "b"

@@ -1,0 +1,192 @@
+"""NodeClass controller, admission (defaulting/validation), and NodeClaim
+lifecycle tests (reference: pkg/controllers/nodeclass/ +
+pkg/apis/v1beta1/*_validation.go + core nodeclaim lifecycle)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import (Disruption, NodeClaim, NodeClass,
+                                       NodePool, NodePoolTemplate)
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import (FakeCloud, ImageInfo, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.cloud.provider import CloudProvider
+from karpenter_tpu.cloud.services import (FakeControlPlane, FakeIAM,
+                                          FakeParameterStore)
+from karpenter_tpu.controllers.lifecycle import LifecycleController
+from karpenter_tpu.controllers.nodeclass import (NodeClassController,
+                                                 ValidationError,
+                                                 default_nodeclass,
+                                                 static_hash,
+                                                 validate_nodeclass,
+                                                 validate_nodepool)
+from karpenter_tpu.providers.imagefamily import ImageProvider
+from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.providers.version import VersionProvider
+from karpenter_tpu.state.cluster import Cluster
+
+
+@pytest.fixture
+def env():
+    cloud = FakeCloud()
+    cloud.subnets = [SubnetInfo("subnet-a", "zone-a", 10, {"team": "x"}),
+                     SubnetInfo("subnet-b", "zone-b", 99, {"team": "x"})]
+    cloud.security_groups = [SecurityGroupInfo("sg-1", "nodes", {"team": "x"})]
+    cloud.images = [ImageInfo("img-1", "std", "amd64", 100.0)]
+    params = FakeParameterStore()
+    params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    iam = FakeIAM()
+    cluster = Cluster()
+    ctrl = NodeClassController(
+        subnets=SubnetProvider(cloud),
+        security_groups=SecurityGroupProvider(cloud),
+        images=ImageProvider(cloud, params,
+                             VersionProvider(FakeControlPlane(version="1.28"))),
+        instance_profiles=InstanceProfileProvider(iam, "kc"),
+        cluster=cluster)
+    return cloud, iam, cluster, ctrl
+
+
+class TestNodeClassController:
+    def test_reconcile_resolves_status(self, env):
+        cloud, iam, cluster, ctrl = env
+        nc = NodeClass(subnet_selector={"team": "x"},
+                       security_group_selector={"team": "x"}, role="node-role")
+        res = ctrl.reconcile(nc)
+        assert res.resolved
+        # subnets sorted most-free-IPs first
+        assert nc.status_subnets == ["subnet-b", "subnet-a"]
+        assert nc.status_zones == ["zone-a", "zone-b"]
+        assert nc.status_security_groups == ["sg-1"]
+        assert nc.status_images == ["img-1"]
+        assert nc.status_instance_profile
+        assert iam.get_instance_profile(nc.status_instance_profile)["_roles"] \
+            == "node-role"
+        assert nc.hash_annotation == static_hash(nc)
+
+    def test_reconcile_reports_unresolved(self, env):
+        cloud, _, _, ctrl = env
+        nc = NodeClass(subnet_selector={"team": "nope"})
+        res = ctrl.reconcile(nc)
+        assert not res.resolved
+        assert any("subnet" in e for e in res.errors)
+
+    def test_hash_changes_with_spec(self):
+        a = NodeClass(user_data="x")
+        b = NodeClass(user_data="y")
+        assert static_hash(a) != static_hash(b)
+        assert static_hash(a) == static_hash(NodeClass(user_data="x"))
+
+    def test_finalize_blocked_by_claims(self, env):
+        _, iam, cluster, ctrl = env
+        nc = NodeClass(name="gpu", role="r")
+        ctrl.reconcile(nc)
+        claim = NodeClaim(nodepool="p", node_class_ref="gpu")
+        cluster.nodeclaims[claim.name] = claim
+        assert not ctrl.finalize(nc)
+        claim.terminating = True
+        assert ctrl.finalize(nc)
+        assert nc.status_instance_profile == ""
+        assert not iam.profiles
+
+
+class TestAdmission:
+    def test_defaulting(self):
+        nc = NodeClass(image_family="", block_device_gib=0)
+        default_nodeclass(nc)
+        assert nc.image_family == "standard"
+        assert nc.block_device_gib == 20
+
+    def test_validate_ok(self):
+        validate_nodeclass(NodeClass())
+
+    def test_validate_unknown_family(self):
+        with pytest.raises(ValidationError):
+            validate_nodeclass(NodeClass(image_family="windows-nt"))
+
+    def test_validate_custom_needs_selector(self):
+        with pytest.raises(ValidationError):
+            validate_nodeclass(NodeClass(image_family="custom"))
+        validate_nodeclass(NodeClass(image_family="custom",
+                                     image_selector={"id": "img-9"}))
+
+    def test_validate_empty_selector_key(self):
+        with pytest.raises(ValidationError):
+            validate_nodeclass(NodeClass(subnet_selector={"": "x"}))
+
+    def test_validate_nodepool_weight_and_policy(self):
+        validate_nodepool(NodePool())
+        with pytest.raises(ValidationError):
+            validate_nodepool(NodePool(weight=101))
+        with pytest.raises(ValidationError):
+            validate_nodepool(NodePool(
+                disruption=Disruption(consolidation_policy="Sometimes")))
+        with pytest.raises(ValidationError):
+            validate_nodepool(NodePool(
+                disruption=Disruption(consolidation_policy="WhenEmpty")))
+
+    def test_validate_nodepool_restricted_labels(self):
+        with pytest.raises(ValidationError):
+            validate_nodepool(NodePool(template=NodePoolTemplate(
+                labels={wk.NODEPOOL: "evil"})))
+
+
+class TestLifecycle:
+    def _env(self, join_delay=0.0, ttl=900.0):
+        clock = [1000.0]
+        cloud = FakeCloud(clock=lambda: clock[0])
+        provider = CloudProvider(cloud, generate_catalog(8),
+                                 clock=lambda: clock[0])
+        cluster = Cluster(clock=lambda: clock[0])
+        pool = NodePool(template=NodePoolTemplate(
+            startup_taints=[Taint("init.example.com/agent", "NoSchedule")]))
+        lc = LifecycleController(provider, cluster, nodepools={"default": pool},
+                                 join_delay=join_delay, registration_ttl=ttl,
+                                 clock=lambda: clock[0])
+        return clock, cloud, provider, cluster, lc, pool
+
+    def _claim(self, provider, pool):
+        claim = NodeClaim(nodepool="default",
+                          taints=list(pool.template.startup_taints))
+        return provider.create(claim)
+
+    def test_async_register_then_initialize(self):
+        clock, cloud, provider, cluster, lc, pool = self._env(join_delay=30)
+        claim = self._claim(provider, pool)
+        lc.track(claim)
+        res = lc.reconcile()
+        assert not res.registered  # kubelet hasn't joined yet
+        assert not cluster.nodes
+        clock[0] += 31
+        res = lc.reconcile()
+        assert res.registered == [claim.name]
+        assert claim.registered and not claim.initialized
+        node = next(iter(cluster.nodes.values()))
+        res = lc.reconcile()  # startup taints cleared, node initializes
+        assert res.initialized == [node.name]
+        assert claim.initialized
+        assert node.labels[wk.NODE_INITIALIZED] == "true"
+        assert not any(t.key == "init.example.com/agent" for t in node.taints)
+
+    def test_registration_ttl_liveness_gc(self):
+        clock, cloud, provider, cluster, lc, pool = self._env(
+            join_delay=float("inf"), ttl=900)
+        claim = self._claim(provider, pool)
+        lc.track(claim)
+        clock[0] += 901
+        res = lc.reconcile()
+        assert res.liveness_terminated == [claim.name]
+        assert claim.name not in cluster.nodeclaims
+        assert not cloud.running()  # instance terminated
+
+    def test_instance_death_before_registration(self):
+        clock, cloud, provider, cluster, lc, pool = self._env(join_delay=60)
+        claim = self._claim(provider, pool)
+        lc.track(claim)
+        cloud.get_instance(claim.provider_id).state = "terminated"
+        res = lc.reconcile()
+        assert res.liveness_terminated == [claim.name]

@@ -129,9 +129,9 @@ class TestBatcherMetricsWiring:
     def test_batcher_records_histograms(self):
         from karpenter_tpu.cloud.batcher import Batcher, Options
         from karpenter_tpu.utils import metrics as m
-        before = m.batch_size("t").count({"batcher": "probe"})
+        before = m.batch_size().count({"batcher": "probe"})
         b = Batcher(Options(name="probe", idle_timeout=0.01, max_timeout=0.1,
                             max_items=10, request_hasher=lambda r: 0,
                             batch_executor=lambda reqs: list(reqs)))
         assert b.add(1) == 1
-        assert m.batch_size("t").count({"batcher": "probe"}) == before + 1
+        assert m.batch_size().count({"batcher": "probe"}) == before + 1

@@ -1,0 +1,195 @@
+"""Operator layer tests: options/settings merge, DI wiring, controller
+manager ticks, batch windows, endpoints, leader election
+(reference: pkg/operator/ + pkg/operator/options/ + cmd/controller/main.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import ImageInfo, SecurityGroupInfo, SubnetInfo
+from karpenter_tpu.operator import (ControllerManager, Operator, Options,
+                                    PodBatchWindow, build_controllers)
+from karpenter_tpu.operator.manager import LeaderElector
+
+
+def pod(cpu=500):
+    return Pod(requests=ResourceList({CPU: cpu, MEMORY: 512 * 2**20}))
+
+
+class TestOptions:
+    def test_defaults(self):
+        o = Options.from_args([])
+        assert o.cluster_name == "default"
+        assert o.vm_memory_overhead_percent == 0.075
+        assert o.batch_idle_duration == 1.0
+        assert o.batch_max_duration == 10.0
+        assert o.gate("Drift")
+
+    def test_flags(self):
+        o = Options.from_args(["--cluster-name", "prod",
+                               "--interruption-queue", "q",
+                               "--feature-gates", "Drift=false,SpotToSpot=true"])
+        assert o.cluster_name == "prod"
+        assert o.interruption_queue == "q"
+        assert not o.gate("Drift")
+        assert o.gate("SpotToSpot")
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_CLUSTER_NAME", "from-env")
+        monkeypatch.setenv("KARPENTER_TPU_BATCH_IDLE_DURATION", "2.5")
+        o = Options.from_args([])
+        assert o.cluster_name == "from-env"
+        assert o.batch_idle_duration == 2.5
+        # explicit flag beats env
+        o2 = Options.from_args(["--cluster-name", "flag-wins"])
+        assert o2.cluster_name == "flag-wins"
+
+    def test_merge_settings_flag_precedence(self):
+        o = Options.from_args(["--cluster-name", "flag"])
+        o.merge_settings({"cluster-name": "cm", "batch-idle-duration": "3",
+                          "tags.team": "infra"})
+        assert o.cluster_name == "flag"          # explicit flag wins
+        assert o.batch_idle_duration == 3.0      # default → settings fill
+        assert o.tags == {"team": "infra"}
+
+
+class TestOperatorWiring:
+    def test_builds_full_provider_graph(self):
+        op = Operator(Options(interruption_queue="q"), catalog=generate_catalog(20))
+        assert op.queue is not None
+        assert op.cloud_provider.subnets is op.subnets
+        assert op.cloud_provider.launch_templates is op.launch_templates
+        assert op.pricing.on_demand_price(op.catalog[0].name) is not None
+        ctrls = build_controllers(op)
+        assert {"provisioning", "termination", "disruption", "lifecycle",
+                "garbagecollection", "tagging", "nodeclass",
+                "interruption", "pricing"} <= set(ctrls)
+
+    def test_conditional_registration(self):
+        op = Operator(Options(isolated_network=True), catalog=generate_catalog(5))
+        ctrls = build_controllers(op)
+        assert "interruption" not in ctrls  # no queue configured
+        assert "pricing" not in ctrls       # isolated network
+
+
+class TestPodBatchWindow:
+    def test_idle_then_ripe(self):
+        t = [0.0]
+        w = PodBatchWindow(idle=1.0, max_timeout=10.0, clock=lambda: t[0])
+        w.observe(3)
+        assert not w.ripe()
+        t[0] = 0.9
+        w.observe(3)
+        assert not w.ripe()
+        t[0] = 1.05
+        assert w.ripe()
+
+    def test_new_arrivals_extend_window(self):
+        t = [0.0]
+        w = PodBatchWindow(idle=1.0, max_timeout=10.0, clock=lambda: t[0])
+        w.observe(1)
+        t[0] = 0.8
+        w.observe(2)   # new pod resets idle
+        t[0] = 1.5
+        assert not w.ripe()
+        t[0] = 1.9
+        assert w.ripe()
+
+    def test_max_timeout_caps_stream(self):
+        t = [0.0]
+        w = PodBatchWindow(idle=1.0, max_timeout=10.0, clock=lambda: t[0])
+        for i in range(20):  # a pod every 0.6s keeps idle unsatisfied
+            w.observe(i + 1)
+            t[0] += 0.6
+            if w.ripe():
+                break
+        assert t[0] <= 10.7  # closed by max_timeout, not idle
+
+    def test_empty_resets(self):
+        t = [0.0]
+        w = PodBatchWindow(idle=1.0, clock=lambda: t[0])
+        w.observe(2)
+        w.observe(0)
+        t[0] = 5
+        assert not w.ripe()
+
+
+class TestControllerManager:
+    def _operator(self, clock):
+        op = Operator(Options(batch_idle_duration=1.0, batch_max_duration=10.0),
+                      catalog=generate_catalog(10), clock=lambda: clock[0])
+        op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 100, {}),
+                            SubnetInfo("s-b", "zone-b", 100, {})]
+        op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+        op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+        op.params.parameters = {
+            "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+        return op
+
+    def test_tick_provisions_after_batch_window(self):
+        clock = [100.0]
+        op = self._operator(clock)
+        mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+        op.cluster.add_pods([pod() for _ in range(4)])
+        res = mgr.tick()
+        assert "provisioning" not in res      # window just opened
+        clock[0] += 1.1                        # idle elapses
+        res = mgr.tick()
+        assert res["provisioning"].scheduled == 4
+        assert len(op.cloud.running()) >= 1
+
+    def test_tick_respects_intervals(self):
+        clock = [100.0]
+        op = self._operator(clock)
+        mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+        first = mgr.tick()
+        assert "disruption" in first
+        second = mgr.tick()                    # same instant: nothing due
+        assert "disruption" not in second
+        clock[0] += 11
+        third = mgr.tick()
+        assert "disruption" in third
+
+    def test_endpoints(self):
+        clock = [100.0]
+        op = self._operator(clock)
+        mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5)
+            assert health.status == 200
+            m = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            assert "# TYPE" in m
+        finally:
+            mgr.stop()
+
+    def test_leader_election_gates_ticks(self, tmp_path):
+        clock = [100.0]
+        lease = str(tmp_path / "lease.json")
+        a = LeaderElector(lease, "a", ttl=15, clock=lambda: clock[0])
+        b = LeaderElector(lease, "b", ttl=15, clock=lambda: clock[0])
+        assert a.try_acquire() and a.is_leader()
+        assert not b.try_acquire() and not b.is_leader()
+        clock[0] += 16                         # lease expires
+        assert b.try_acquire() and b.is_leader()
+        assert not a.is_leader()
+
+    def test_follower_does_not_reconcile(self, tmp_path):
+        clock = [100.0]
+        op = self._operator(clock)
+        lease = str(tmp_path / "lease.json")
+        holder = LeaderElector(lease, "other", ttl=1000, clock=lambda: clock[0])
+        assert holder.try_acquire()
+        follower = ControllerManager(
+            op, build_controllers(op), clock=lambda: clock[0],
+            leader=LeaderElector(lease, "me", ttl=1000, clock=lambda: clock[0]))
+        op.cluster.add_pods([pod()])
+        clock[0] += 5
+        assert follower.tick() == {}           # not leader → no work
+        assert not op.cloud.running()
