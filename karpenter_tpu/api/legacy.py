@@ -1,0 +1,108 @@
+"""Legacy (alpha-era) API kinds and their conversion to the current API.
+
+The reference carries two deprecated generations — `Provisioner`
+(karpenter.sh/v1alpha5) and `AWSNodeTemplate`
+(/root/reference/pkg/apis/v1alpha1/awsnodetemplate.go:95 + provider.go:24)
+— and ships `karpenter-convert` to migrate manifests to
+NodePool/EC2NodeClass (/root/reference/tools/karpenter-convert/README.md:1-10).
+This module is both halves: the legacy manifest shapes and the conversion.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .objects import Disruption, NodeClass, NodePool, NodePoolTemplate
+from .requirements import Requirements
+from .resources import ResourceList
+from .serialize import (GROUP, VERSION, _parse_duration, _selector_from_terms,
+                        nodeclass_to_manifest, nodepool_to_manifest,
+                        requirement_from_dict, taint_from_dict)
+
+LEGACY_GROUP = "karpenter.tpu"
+LEGACY_VERSION = "v1alpha5"
+
+
+def convert_provisioner(m: Dict) -> Dict:
+    """Legacy Provisioner manifest → NodePool manifest.
+
+    Field moves (karpenter-convert semantics):
+      spec.{requirements,taints,startupTaints,labels}  → spec.template.spec/metadata
+      spec.providerRef                                 → template.spec.nodeClassRef
+      spec.ttlSecondsAfterEmpty                        → disruption{WhenEmpty, consolidateAfter}
+      spec.consolidation.enabled                       → disruption.WhenUnderutilized
+      spec.ttlSecondsUntilExpired                      → disruption.expireAfter
+      spec.{limits,weight}                             → unchanged
+    """
+    spec = m.get("spec", {})
+    template = NodePoolTemplate(
+        labels=dict(spec.get("labels", {})),
+        annotations=dict(spec.get("annotations", {})),
+        requirements=Requirements.of(*[requirement_from_dict(r)
+                                       for r in spec.get("requirements", [])]),
+        taints=[taint_from_dict(t) for t in spec.get("taints", [])],
+        startup_taints=[taint_from_dict(t)
+                        for t in spec.get("startupTaints", [])],
+        node_class_ref=spec.get("providerRef", {}).get("name", "default"),
+    )
+    if spec.get("consolidation", {}).get("enabled"):
+        disruption = Disruption(consolidation_policy="WhenUnderutilized")
+    elif "ttlSecondsAfterEmpty" in spec:
+        disruption = Disruption(
+            consolidation_policy="WhenEmpty",
+            consolidate_after_s=float(spec["ttlSecondsAfterEmpty"]))
+    else:
+        disruption = Disruption(consolidation_policy="WhenUnderutilized")
+    if "ttlSecondsUntilExpired" in spec:
+        disruption.expire_after_s = float(spec["ttlSecondsUntilExpired"])
+    limits = spec.get("limits", {})
+    pool = NodePool(
+        name=m.get("metadata", {}).get("name", "default"),
+        template=template,
+        disruption=disruption,
+        limits=ResourceList.parse(limits.get("resources", limits) or {}),
+        weight=int(spec.get("weight", 0)),
+    )
+    return nodepool_to_manifest(pool)
+
+
+def convert_node_template(m: Dict) -> Dict:
+    """Legacy NodeTemplate (AWSNodeTemplate analog) → NodeClass manifest.
+
+    Field moves: amiFamily→imageFamily, {subnet,securityGroup,ami}Selector
+    flat tag maps → *SelectorTerms, instanceProfile/role, userData,
+    blockDeviceMappings[0] size → blockDeviceGiB."""
+    spec = m.get("spec", {})
+    bdm = spec.get("blockDeviceMappings", [])
+    gib = 20
+    if bdm:
+        size = str(bdm[0].get("ebs", bdm[0]).get("volumeSize", "20Gi"))
+        gib = int(float(size.rstrip("Gi"))) if size.endswith("Gi") \
+            else int(float(size))
+    family_map = {"AL2": "standard", "Bottlerocket": "config",
+                  "Custom": "custom"}
+    family = spec.get("amiFamily", "standard")
+    nc = NodeClass(
+        name=m.get("metadata", {}).get("name", "default"),
+        image_family=family_map.get(family, family),
+        subnet_selector=dict(spec.get("subnetSelector", {})),
+        security_group_selector=dict(spec.get("securityGroupSelector", {})),
+        image_selector=dict(spec.get("amiSelector", {})),
+        role=spec.get("role", spec.get("instanceProfile", "")),
+        user_data=spec.get("userData", ""),
+        tags=dict(spec.get("tags", {})),
+        block_device_gib=gib,
+    )
+    return nodeclass_to_manifest(nc)
+
+
+def convert_manifest(m: Dict) -> Dict:
+    """Dispatch on kind; current-API kinds pass through unchanged."""
+    kind = m.get("kind", "")
+    if kind == "Provisioner":
+        return convert_provisioner(m)
+    if kind in ("NodeTemplate", "AWSNodeTemplate"):
+        return convert_node_template(m)
+    if kind in ("NodePool", "NodeClass"):
+        return m
+    raise ValueError(f"cannot convert kind {kind!r}")

@@ -244,7 +244,7 @@ class NodeClaim:
     capacity_type: str = ""
     price: float = 0.0
     launched_at: float = 0.0
-    created_at: float = field(default_factory=time.time)
+    created_at: float = 0.0  # stamped by the provider's injected clock
     registered: bool = False
     registered_at: float = 0.0
     initialized: bool = False

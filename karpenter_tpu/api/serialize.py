@@ -1,0 +1,273 @@
+"""Manifest (de)serialization for the API objects.
+
+The reference's CRDs (/root/reference/pkg/apis/crds/*.yaml) define the
+wire format users write; this module is the equivalent seam: NodePool /
+NodeClass / NodeClaim ↔ manifest dicts (YAML/JSON), plus generated
+JSON-schema documents mirroring the CRD validation surface.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import labels as wk
+from .objects import (Disruption, KubeletConfiguration, NodeClaim, NodeClass,
+                      NodePool, NodePoolTemplate)
+from .requirements import Requirement, Requirements
+from .resources import ResourceList, format_quantity
+from .taints import Taint
+
+GROUP = "karpenter.tpu"
+VERSION = "v1beta1"
+
+
+# ---------------------------------------------------------------------------
+# requirements / taints / resources
+# ---------------------------------------------------------------------------
+
+def requirement_to_dict(r: Requirement) -> Dict:
+    if r.greater_than is not None:
+        return {"key": r.key, "operator": "Gt",
+                "values": [str(r.greater_than)]}
+    if r.less_than is not None:
+        return {"key": r.key, "operator": "Lt", "values": [str(r.less_than)]}
+    if r.complement and not r.values:
+        return {"key": r.key, "operator": "Exists"}
+    if not r.complement and not r.values:
+        return {"key": r.key, "operator": "DoesNotExist"}
+    return {"key": r.key, "operator": "NotIn" if r.complement else "In",
+            "values": sorted(r.values)}
+
+
+def requirement_from_dict(d: Dict) -> Requirement:
+    return Requirement(d["key"], d.get("operator", "In"),
+                       list(d.get("values", [])))
+
+
+def taint_to_dict(t: Taint) -> Dict:
+    out = {"key": t.key, "effect": t.effect}
+    if t.value:
+        out["value"] = t.value
+    return out
+
+
+def taint_from_dict(d: Dict) -> Taint:
+    return Taint(d["key"], d.get("effect", "NoSchedule"), d.get("value", ""))
+
+
+# ---------------------------------------------------------------------------
+# NodePool
+# ---------------------------------------------------------------------------
+
+def nodepool_to_manifest(pool: NodePool) -> Dict:
+    t = pool.template
+    spec: Dict = {
+        "template": {
+            "metadata": {"labels": dict(t.labels),
+                         "annotations": dict(t.annotations)},
+            "spec": {
+                "nodeClassRef": {"name": t.node_class_ref},
+                "requirements": [requirement_to_dict(r)
+                                 for r in t.requirements.values()],
+                "taints": [taint_to_dict(x) for x in t.taints],
+                "startupTaints": [taint_to_dict(x) for x in t.startup_taints],
+            },
+        },
+        "disruption": _disruption_to_dict(pool.disruption),
+        "weight": pool.weight,
+    }
+    if pool.limits:
+        spec["limits"] = {k: format_quantity(v, k)
+                          for k, v in pool.limits.items()}
+    return {"apiVersion": f"{GROUP}/{VERSION}", "kind": "NodePool",
+            "metadata": {"name": pool.name}, "spec": spec}
+
+
+def _disruption_to_dict(d: Disruption) -> Dict:
+    out: Dict = {"consolidationPolicy": d.consolidation_policy}
+    if d.consolidate_after_s is not None:
+        out["consolidateAfter"] = f"{int(d.consolidate_after_s)}s"
+    out["expireAfter"] = ("Never" if d.expire_after_s is None
+                          else f"{int(d.expire_after_s)}s")
+    return out
+
+
+def _parse_duration(v) -> Optional[float]:
+    if v in (None, "Never"):
+        return None
+    s = str(v)
+    units = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    if s and s[-1] in units:
+        return float(s[:-1]) * units[s[-1]]
+    return float(s)
+
+
+def nodepool_from_manifest(m: Dict) -> NodePool:
+    spec = m.get("spec", {})
+    tm = spec.get("template", {})
+    tspec = tm.get("spec", {})
+    template = NodePoolTemplate(
+        labels=dict(tm.get("metadata", {}).get("labels", {})),
+        annotations=dict(tm.get("metadata", {}).get("annotations", {})),
+        requirements=Requirements.of(*[requirement_from_dict(r)
+                                       for r in tspec.get("requirements", [])]),
+        taints=[taint_from_dict(x) for x in tspec.get("taints", [])],
+        startup_taints=[taint_from_dict(x)
+                        for x in tspec.get("startupTaints", [])],
+        node_class_ref=tspec.get("nodeClassRef", {}).get("name", "default"),
+    )
+    d = spec.get("disruption", {})
+    disruption = Disruption(
+        consolidation_policy=d.get("consolidationPolicy", "WhenUnderutilized"),
+        consolidate_after_s=_parse_duration(d.get("consolidateAfter")),
+        expire_after_s=_parse_duration(d.get("expireAfter", "Never")),
+    )
+    return NodePool(
+        name=m.get("metadata", {}).get("name", "default"),
+        template=template, disruption=disruption,
+        limits=ResourceList.parse(spec.get("limits", {}) or {}),
+        weight=int(spec.get("weight", 0)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# NodeClass
+# ---------------------------------------------------------------------------
+
+def nodeclass_to_manifest(nc: NodeClass) -> Dict:
+    spec: Dict = {
+        "imageFamily": nc.image_family,
+        "subnetSelectorTerms": [{"tags": dict(nc.subnet_selector)}]
+        if nc.subnet_selector else [],
+        "securityGroupSelectorTerms": [{"tags": dict(nc.security_group_selector)}]
+        if nc.security_group_selector else [],
+        "imageSelectorTerms": [{"tags": dict(nc.image_selector)}]
+        if nc.image_selector else [],
+        "role": nc.role,
+        "userData": nc.user_data,
+        "tags": dict(nc.tags),
+        "blockDeviceGiB": nc.block_device_gib,
+    }
+    if nc.zone_selector:
+        spec["zones"] = list(nc.zone_selector)
+    out = {"apiVersion": f"{GROUP}/{VERSION}", "kind": "NodeClass",
+           "metadata": {"name": nc.name}, "spec": spec}
+    status = {}
+    if nc.status_subnets:
+        status["subnets"] = list(nc.status_subnets)
+    if nc.status_security_groups:
+        status["securityGroups"] = list(nc.status_security_groups)
+    if nc.status_images:
+        status["images"] = list(nc.status_images)
+    if status:
+        out["status"] = status
+    return out
+
+
+def _selector_from_terms(terms: List[Dict]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for term in terms or []:
+        out.update(term.get("tags", {}))
+        if "id" in term:
+            out["id"] = term["id"]
+        if "name" in term:
+            out["name"] = term["name"]
+    return out
+
+
+def nodeclass_from_manifest(m: Dict) -> NodeClass:
+    spec = m.get("spec", {})
+    return NodeClass(
+        name=m.get("metadata", {}).get("name", "default"),
+        image_family=spec.get("imageFamily", "standard"),
+        zone_selector=list(spec.get("zones", [])),
+        subnet_selector=_selector_from_terms(spec.get("subnetSelectorTerms")),
+        security_group_selector=_selector_from_terms(
+            spec.get("securityGroupSelectorTerms")),
+        image_selector=_selector_from_terms(spec.get("imageSelectorTerms")),
+        role=spec.get("role", ""),
+        user_data=spec.get("userData", ""),
+        tags=dict(spec.get("tags", {})),
+        block_device_gib=int(spec.get("blockDeviceGiB", 20)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# CRD-schema generation (pkg/apis/crds analog)
+# ---------------------------------------------------------------------------
+
+def crd_schemas() -> Dict[str, Dict]:
+    """JSON-schema documents for the API kinds — the validation surface the
+    reference ships as CRD openAPIV3Schema blocks."""
+    requirement_schema = {
+        "type": "object",
+        "required": ["key"],
+        "properties": {
+            "key": {"type": "string", "minLength": 1},
+            "operator": {"enum": ["In", "NotIn", "Exists", "DoesNotExist",
+                                  "Gt", "Lt"]},
+            "values": {"type": "array", "items": {"type": "string"}},
+        },
+    }
+    taint_schema = {
+        "type": "object",
+        "required": ["key", "effect"],
+        "properties": {
+            "key": {"type": "string"},
+            "value": {"type": "string"},
+            "effect": {"enum": ["NoSchedule", "PreferNoSchedule", "NoExecute"]},
+        },
+    }
+    return {
+        "NodePool": {
+            "$schema": "https://json-schema.org/draft/2020-12/schema",
+            "title": f"NodePool.{GROUP}/{VERSION}",
+            "type": "object",
+            "required": ["spec"],
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "required": ["template"],
+                    "properties": {
+                        "template": {"type": "object"},
+                        "weight": {"type": "integer", "minimum": 0,
+                                   "maximum": 100},
+                        "limits": {"type": "object"},
+                        "disruption": {
+                            "type": "object",
+                            "properties": {
+                                "consolidationPolicy": {
+                                    "enum": ["WhenUnderutilized", "WhenEmpty"]},
+                                "consolidateAfter": {"type": "string"},
+                                "expireAfter": {"type": "string"},
+                            },
+                        },
+                        "requirements": {"type": "array",
+                                         "items": requirement_schema},
+                        "taints": {"type": "array", "items": taint_schema},
+                    },
+                },
+            },
+        },
+        "NodeClass": {
+            "$schema": "https://json-schema.org/draft/2020-12/schema",
+            "title": f"NodeClass.{GROUP}/{VERSION}",
+            "type": "object",
+            "required": ["spec"],
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "properties": {
+                        "imageFamily": {"enum": ["standard", "config",
+                                                 "custom"]},
+                        "subnetSelectorTerms": {"type": "array"},
+                        "securityGroupSelectorTerms": {"type": "array"},
+                        "imageSelectorTerms": {"type": "array"},
+                        "role": {"type": "string"},
+                        "userData": {"type": "string"},
+                        "blockDeviceGiB": {"type": "integer", "minimum": 1},
+                    },
+                },
+            },
+        },
+    }

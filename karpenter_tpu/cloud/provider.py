@@ -36,6 +36,13 @@ class InsufficientCapacityError(Exception):
     (error taxonomy analog: /root/reference/pkg/errors/errors.go:56-103)."""
 
 
+class NodeClassNotFoundError(InsufficientCapacityError):
+    """The claim references a nodeclass that doesn't exist — a persistent
+    configuration error, not a capacity shortage (reference NotFound class,
+    errors.go:56-103).  Subclasses InsufficientCapacityError so the launch
+    path's retry handling still applies, but callers can log it distinctly."""
+
+
 @dataclass
 class InstanceTypesProvider:
     """Catalog provider with ICE masking + memoization keyed on the
@@ -164,6 +171,8 @@ class CloudProvider:
         """Launch capacity for a NodeClaim
         (/root/reference/pkg/cloudprovider/cloudprovider.go:92-118 →
         /root/reference/pkg/providers/instance/instance.go:88-105)."""
+        if not claim.created_at:
+            claim.created_at = self.clock()
         candidates = _claim_compatible_types(claim, self.instance_types.list())
         if not candidates:
             raise InsufficientCapacityError(
@@ -175,7 +184,7 @@ class CloudProvider:
             # error — launching without subnets/images would produce a
             # misconfigured node (reference errors on nodeclass resolution,
             # cloudprovider.go:231-241)
-            raise InsufficientCapacityError(
+            raise NodeClassNotFoundError(
                 f"nodeclass {claim.node_class_ref!r} not found for claim "
                 f"{claim.name}")
         # zonal subnet choice with in-flight IP accounting

@@ -141,9 +141,8 @@ def validate_nodeclass(nodeclass: NodeClass) -> None:
                     f"(want one of {FAMILIES})")
     if nodeclass.image_family == "custom" and not nodeclass.image_selector:
         errs.append("custom image family requires an image selector")
-    if nodeclass.image_family != "custom" and nodeclass.user_data and \
-            nodeclass.user_data.lstrip().startswith("MIME-Version") and \
-            nodeclass.image_family == "config":
+    if nodeclass.image_family == "config" and \
+            nodeclass.user_data.lstrip().startswith("MIME-Version"):
         errs.append("config family user data must be key=value settings, "
                     "not MIME")
     if nodeclass.block_device_gib < 1:

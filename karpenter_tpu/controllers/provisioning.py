@@ -25,7 +25,8 @@ from ..api import labels as wk
 from ..api.objects import Node, NodeClaim, NodePool, Pod
 from ..api.requirements import IN, Requirement, Requirements
 from ..api.resources import PODS, ResourceList
-from ..cloud.provider import CloudProvider, InsufficientCapacityError
+from ..cloud.provider import (CloudProvider, InsufficientCapacityError,
+                              NodeClassNotFoundError)
 from ..ops.constraints import (MAX_LEVEL, find_batch_topology_violations,
                                has_soft_constraints, lower_pods,
                                make_zone_feasibility)
@@ -239,8 +240,13 @@ class Provisioner:
                 claim = self.provider.create(claim)
             except InsufficientCapacityError as e:
                 # leave pods pending; ICE cache updated inside create() so the
-                # next round solves against a corrected catalog
-                log.warning("launch failed: %s", e)
+                # next round solves against a corrected catalog. A missing
+                # nodeclass is a persistent config error, not capacity — log
+                # it at error so operators see it isn't self-healing.
+                if isinstance(e, NodeClassNotFoundError):
+                    log.error("launch blocked by configuration: %s", e)
+                else:
+                    log.warning("launch failed: %s", e)
                 out.failed_launches.append(str(e))
                 out.unschedulable.extend(dpods)
                 continue

@@ -101,7 +101,12 @@ def ffd_pack_native(requests: np.ndarray, compat: np.ndarray,
     class_ids = np.ascontiguousarray(class_ids, np.int32)
     caps = np.ascontiguousarray(caps, np.int32)
     alloc = np.ascontiguousarray(alloc, np.float32)
-    eu = np.ascontiguousarray(existing_used, np.float32) if E else None
+    if E:
+        # None == existing nodes start empty (zero-fill like the JAX path)
+        eu = (np.ascontiguousarray(existing_used, np.float32)
+              if existing_used is not None else np.zeros((E, R), np.float32))
+    else:
+        eu = None
     assignment = np.empty(P, np.int32)
     slot_option = np.empty(K, np.int32)
     slot_used = np.zeros((K, R), np.float32)

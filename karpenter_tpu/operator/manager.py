@@ -79,20 +79,32 @@ class LeaderElector:
         self.clock = clock
 
     def try_acquire(self) -> bool:
-        now = self.clock()
+        """Read-decide-write under an exclusive lockfile so two replicas
+        racing at lease expiry cannot both win (the read-then-replace
+        without it is not atomic)."""
+        lock = f"{self.lease_path}.lock"
         try:
-            with open(self.lease_path) as f:
-                lease = json.load(f)
-            if lease["holder"] != self.identity and \
-                    now - lease["renewed"] < self.ttl:
-                return False
-        except (OSError, ValueError, KeyError):
-            pass
-        tmp = f"{self.lease_path}.{self.identity}.tmp"
-        with open(tmp, "w") as f:
-            json.dump({"holder": self.identity, "renewed": now}, f)
-        os.replace(tmp, self.lease_path)
-        return True
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return self.is_leader()  # someone else is mid-update
+        try:
+            now = self.clock()
+            try:
+                with open(self.lease_path) as f:
+                    lease = json.load(f)
+                if lease["holder"] != self.identity and \
+                        now - lease["renewed"] < self.ttl:
+                    return False
+            except (OSError, ValueError, KeyError):
+                pass
+            tmp = f"{self.lease_path}.{self.identity}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"holder": self.identity, "renewed": now}, f)
+            os.replace(tmp, self.lease_path)
+            return True
+        finally:
+            os.close(fd)
+            os.unlink(lock)
 
     def is_leader(self) -> bool:
         try:

@@ -85,6 +85,13 @@ class Options:
             health_port=ns.health_port,
             leader_elect=ns.leader_elect,
         )
+        # env-provided gates/tags apply first; explicit --feature-gates wins
+        for gate in filter(None, str(env.get("feature_gates", "")).split(",")):
+            name, _, value = gate.partition("=")
+            opts.feature_gates[name.strip()] = value.strip().lower() != "false"
+        for tag in filter(None, str(env.get("tags", "")).split(",")):
+            k, _, v = tag.partition("=")
+            opts.tags[k.strip()] = v.strip()
         for gate in filter(None, ns.feature_gates.split(",")):
             name, _, value = gate.partition("=")
             opts.feature_gates[name.strip()] = value.strip().lower() != "false"

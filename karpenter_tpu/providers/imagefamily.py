@@ -153,13 +153,17 @@ class ImageProvider:
 
     def get(self, nodeclass: NodeClass, archs: Sequence[str] = ("amd64", "arm64")
             ) -> List[ImageInfo]:
-        key = (nodeclass.image_family, tuple(archs),
-               tuple(sorted(nodeclass.image_selector.items())))
+        # the control-plane version is part of the published path, so it is
+        # part of the key; empty resolutions are NOT cached (a transient
+        # failure must not block launches for a whole TTL)
+        key = (nodeclass.image_family, self.version_provider.get(),
+               tuple(archs), tuple(sorted(nodeclass.image_selector.items())))
         cached = self._cache.get(key)
         if cached is not None:
             return list(cached)
         out = self._resolve(nodeclass, archs)
-        self._cache.set(key, out)
+        if out:
+            self._cache.set(key, out)
         return list(out)
 
     def reset_cache(self):

@@ -38,9 +38,12 @@ class ResolvedTemplate:
     instance_types: List[InstanceType]
 
 
-def template_name(spec: LaunchSpec, cluster_name: str) -> str:
+def template_name(spec: LaunchSpec, cluster_name: str,
+                  nodeclass_name: str = "") -> str:
     """Content-addressed template name — hash of every boot-affecting field
-    (launchtemplate.go launchTemplateName)."""
+    (launchtemplate.go launchTemplateName).  The owning nodeclass is part of
+    the identity so per-nodeclass GC (delete_all) can never collect a
+    template another nodeclass still references."""
     payload = json.dumps({
         "image": spec.image.id,
         "user_data": spec.user_data,
@@ -49,6 +52,7 @@ def template_name(spec: LaunchSpec, cluster_name: str) -> str:
         "bdm": spec.block_device_gib,
         "tags": sorted(spec.tags.items()),
         "cluster": cluster_name,
+        "nodeclass": nodeclass_name,
     }, sort_keys=True)
     return NAME_PREFIX + hashlib.sha256(payload.encode()).hexdigest()[:16]
 
@@ -76,7 +80,7 @@ class LaunchTemplateProvider:
                 for spec in specs]
 
     def _ensure(self, spec: LaunchSpec, nodeclass: NodeClass) -> LaunchTemplateInfo:
-        name = template_name(spec, self.cluster_name)
+        name = template_name(spec, self.cluster_name, nodeclass.name)
         cached = self._cache.get(name)
         if cached is not None:
             return cached

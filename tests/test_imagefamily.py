@@ -201,3 +201,14 @@ class TestDeleteAllScoping:
         remaining = list(cloud.launch_templates.values())
         assert len(remaining) == 1
         assert remaining[0].tags["karpenter.sh/nodeclass"] == "b"
+
+    def test_identical_specs_get_distinct_templates(self, cloud, image_provider):
+        r = Resolver(image_provider, "kc", "https://ep")
+        p = LaunchTemplateProvider(cloud, r, "kc")
+        catalog = generate_catalog(2)
+        p.ensure_all(NodeClass(name="a"), catalog)
+        p.ensure_all(NodeClass(name="b"), catalog)  # same spec, other owner
+        assert len(cloud.launch_templates) == 2
+        p.delete_all(NodeClass(name="a"))
+        # b's template survives a's finalize even though specs were identical
+        assert len(cloud.launch_templates) == 1

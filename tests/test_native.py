@@ -93,3 +93,13 @@ def test_native_honors_class_node_cap():
 def test_build_is_idempotent():
     assert native.build()
     assert native.build()
+
+
+def test_native_existing_nodes_default_empty_usage():
+    # existing_used=None must behave as zero-fill, same as the JAX path
+    prob = random_problem(13, n_pods=10)
+    existing_alloc = np.tile(prob.option_alloc[-1], (2, 1))
+    a = native.solve_ffd_native(prob, existing_alloc=existing_alloc)
+    b = solve_ffd(prob, existing_alloc=existing_alloc, backend="jax")
+    assert_same_result(a, b)
+    assert a.existing_assignments

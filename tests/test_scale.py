@@ -1,0 +1,185 @@
+"""Scale suite: in-process analogs of the reference's E2E scale tests
+(/root/reference/test/suites/scale/provisioning_test.go:69-145 and
+deprovisioning_test.go:112-428).  The reference bounds these at 30m against
+real clusters; here the same shapes run against the fake substrate in
+seconds, asserting the same end states."""
+
+import time
+
+import pytest
+
+from helpers import cpu_pod, make_type
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (Disruption, NodePool, NodePoolTemplate,
+                                       Pod, PodAffinityTerm)
+from karpenter_tpu.api.resources import CPU, MEMORY, PODS, ResourceList
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.controllers.disruption import DisruptionController
+from karpenter_tpu.state import Cluster
+
+
+def scale_catalog():
+    return [make_type("s.large", 8, 16, 0.40, zones=("zone-a", "zone-b")),
+            make_type("s.xlarge", 16, 32, 0.80, zones=("zone-a", "zone-b")),
+            make_type("s.4xlarge", 64, 128, 3.20, zones=("zone-a", "zone-b"))]
+
+
+def env(pools=None, clock=None):
+    kw = {"clock": clock} if clock else {}
+    cloud = FakeCloud(**kw)
+    provider = CloudProvider(cloud, scale_catalog(), **kw)
+    cluster = Cluster(**kw)
+    pools = pools or [NodePool()]
+    prov = Provisioner(provider, cluster, pools)
+    return cloud, provider, cluster, prov, pools
+
+
+def drain_disruption(ctrl, max_rounds=50, clock=None, step=15.0):
+    """Run single-action reconcile loops to quiescence (the reference's
+    controller executes one action per pass), advancing the fake clock
+    between passes so empty-since / stabilization timers progress."""
+    rounds = 0
+    idle = 0
+    while rounds < max_rounds:
+        rounds += 1
+        res = ctrl.reconcile()
+        if res.action is None:
+            idle += 1
+            if idle >= 3:  # a few idle passes: timers may still be running
+                break
+        else:
+            idle = 0
+        if clock is not None:
+            clock[0] += step
+    return rounds
+
+
+@pytest.mark.scale
+def test_node_dense_500_nodes_one_pod_each():
+    """500 pods × hostname anti-affinity → exactly 500 nodes
+    (provisioning_test.go:69-112)."""
+    cloud, provider, cluster, prov, _ = env()
+    pods = [cpu_pod(cpu_m=500, mem_mib=512, labels={"app": "dense"},
+                    pod_affinities=[PodAffinityTerm(
+                        topology_key=wk.HOSTNAME,
+                        label_selector={"app": "dense"},
+                        anti=True, required=True)])
+            for _ in range(500)]
+    cluster.add_pods(pods)
+    t0 = time.perf_counter()
+    res = prov.provision()
+    dt = time.perf_counter() - t0
+    assert not res.unschedulable
+    assert len(cluster.nodes) == 500
+    assert all(len(n.pods) == 1 for n in cluster.nodes.values())
+    assert dt < 120  # reference budget: 30 minutes on real clusters
+
+
+@pytest.mark.scale
+def test_pod_dense_6600_pods():
+    """6,600 small pods pack densely (110/node shape,
+    provisioning_test.go:113-145)."""
+    cloud, provider, cluster, prov, _ = env()
+    cluster.add_pods([cpu_pod(cpu_m=50, mem_mib=64) for _ in range(6600)])
+    res = prov.provision()
+    assert not res.unschedulable
+    assert res.scheduled == 6600
+    # dense: pod-slot capacity (110/node on the biggest type), not 1 pod/node
+    assert len(cluster.nodes) <= 70
+
+
+@pytest.mark.scale
+def test_consolidation_delete_200_empty_nodes():
+    """200 empty nodes drain to zero once past the stabilization window
+    (deprovisioning_test.go:325-376)."""
+    clock = [1000.0]
+    cloud, provider, cluster, prov, pools = env(
+        pools=[NodePool(disruption=Disruption(
+            consolidation_policy="WhenEmpty", consolidate_after_s=10))],
+        clock=lambda: clock[0])
+    cluster.add_pods([cpu_pod(cpu_m=4000, mem_mib=4096) for _ in range(200)])
+    res = prov.provision()
+    assert len(cluster.nodes) >= 200 or res.scheduled == 200
+    # all pods go away → nodes empty
+    for node in list(cluster.nodes.values()):
+        for p in list(node.pods):
+            cluster.delete_pod(p)
+    clock[0] += 600  # stabilization + emptiness TTL
+    ctrl = DisruptionController(provider, cluster, pools,
+                                clock=lambda: clock[0])
+    drain_disruption(ctrl, clock=clock)
+    assert len(cluster.nodes) == 0
+    assert cloud.running() == []
+
+
+@pytest.mark.scale
+def test_multi_consolidation_200_to_underutilized():
+    """200 nodes at 20% residual load consolidate away the excess
+    (deprovisioning_test.go:377-428: 80% deleted)."""
+    clock = [1000.0]
+    cloud, provider, cluster, prov, pools = env(clock=lambda: clock[0])
+    # one 4-cpu pod per s.large node
+    pods = [cpu_pod(cpu_m=4000, mem_mib=2048, labels={"app": "w", "i": str(i)},
+                    pod_affinities=[PodAffinityTerm(
+                        topology_key=wk.HOSTNAME, label_selector={"app": "w"},
+                        anti=True, required=True)])
+            for i in range(200)]
+    cluster.add_pods(pods)
+    prov.provision()
+    n_before = len(cluster.nodes)
+    assert n_before >= 200
+    # anti-affinity pods gone; keep 40 plain pods → ~80% of capacity is idle
+    survivors = 0
+    for node in list(cluster.nodes.values()):
+        for p in list(node.pods):
+            cluster.delete_pod(p)
+    cluster.add_pods([cpu_pod(cpu_m=4000, mem_mib=2048) for _ in range(40)])
+    prov.provision()
+    clock[0] += 600
+    ctrl = DisruptionController(provider, cluster, pools,
+                                clock=lambda: clock[0])
+    drain_disruption(ctrl, max_rounds=260, clock=clock)
+    # ≥80% of the original fleet is gone; survivors still hold every pod
+    assert len(cluster.nodes) <= n_before * 0.25
+    bound = sum(len(n.pods) for n in cluster.nodes.values())
+    assert bound == 40
+
+
+@pytest.mark.scale
+def test_combined_disruption_methods():
+    """Expiration + emptiness + consolidation acting on one fleet
+    (deprovisioning_test.go:112-322)."""
+    clock = [1000.0]
+    pools = [
+        NodePool(name="expiring", disruption=Disruption(expire_after_s=300),
+                 template=NodePoolTemplate(labels={"pool": "expiring"})),
+        NodePool(name="empty", disruption=Disruption(
+            consolidation_policy="WhenEmpty", consolidate_after_s=10),
+            template=NodePoolTemplate(labels={"pool": "empty"})),
+    ]
+    cloud, provider, cluster, prov, _ = env(pools=pools, clock=lambda: clock[0])
+    sel_exp = {"pool": "expiring"}
+    sel_empty = {"pool": "empty"}
+    cluster.add_pods(
+        [cpu_pod(cpu_m=4000, mem_mib=2048, node_selector=sel_exp)
+         for _ in range(10)] +
+        [cpu_pod(cpu_m=4000, mem_mib=2048, node_selector=sel_empty)
+         for _ in range(10)])
+    prov.provision()
+    empty_nodes = [n for n in cluster.nodes.values() if n.nodepool == "empty"]
+    for node in empty_nodes:
+        for p in list(node.pods):
+            cluster.delete_pod(p)   # "empty" pool drains to emptiness
+    clock[0] += 600                 # expiry + TTLs all lapse
+    ctrl = DisruptionController(provider, cluster, pools,
+                                clock=lambda: clock[0])
+    drain_disruption(ctrl, max_rounds=80, clock=clock)
+    # empty-pool nodes deleted outright; expired nodes replaced with fresh
+    # ones that still carry the pods
+    assert all(n.nodepool != "empty" for n in cluster.nodes.values())
+    bound = sum(len(n.pods) for n in cluster.nodes.values())
+    assert bound == 10
+    now = clock[0]
+    for n in cluster.nodes.values():
+        assert now - n.created_at < 300  # every survivor is a fresh node

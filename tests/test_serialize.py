@@ -1,0 +1,180 @@
+"""Manifest serialization, CRD schemas, legacy conversion, and the convert
+tool (reference: pkg/apis/crds + tools/karpenter-convert)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.legacy import (convert_manifest, convert_node_template,
+                                      convert_provisioner)
+from karpenter_tpu.api.objects import (Disruption, NodeClass, NodePool,
+                                       NodePoolTemplate)
+from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.api.serialize import (crd_schemas, nodeclass_from_manifest,
+                                         nodeclass_to_manifest,
+                                         nodepool_from_manifest,
+                                         nodepool_to_manifest,
+                                         requirement_from_dict,
+                                         requirement_to_dict)
+from karpenter_tpu.api.taints import Taint
+
+
+class TestRequirementRoundtrip:
+    @pytest.mark.parametrize("d", [
+        {"key": "k", "operator": "In", "values": ["a", "b"]},
+        {"key": "k", "operator": "NotIn", "values": ["a"]},
+        {"key": "k", "operator": "Exists"},
+        {"key": "k", "operator": "DoesNotExist"},
+        {"key": "k", "operator": "Gt", "values": ["4"]},
+        {"key": "k", "operator": "Lt", "values": ["9"]},
+    ])
+    def test_roundtrip(self, d):
+        r = requirement_from_dict(d)
+        back = requirement_to_dict(r)
+        assert back["operator"] == d["operator"]
+        assert sorted(back.get("values", [])) == sorted(d.get("values", []))
+
+
+class TestNodePoolRoundtrip:
+    def test_roundtrip(self):
+        pool = NodePool(
+            name="gpu",
+            template=NodePoolTemplate(
+                labels={"team": "ml"},
+                requirements=Requirements.of(
+                    Requirement(wk.CAPACITY_TYPE, IN, ["spot"])),
+                taints=[Taint("gpu", "NoSchedule", "true")],
+                node_class_ref="gpu-class"),
+            disruption=Disruption(consolidation_policy="WhenEmpty",
+                                  consolidate_after_s=30,
+                                  expire_after_s=3600),
+            limits=ResourceList({CPU: 100_000, MEMORY: 2**40}),
+            weight=10)
+        m = nodepool_to_manifest(pool)
+        assert m["kind"] == "NodePool"
+        assert m["spec"]["disruption"]["consolidateAfter"] == "30s"
+        assert m["spec"]["disruption"]["expireAfter"] == "3600s"
+        back = nodepool_from_manifest(m)
+        assert back.name == "gpu"
+        assert back.template.labels == {"team": "ml"}
+        assert back.template.node_class_ref == "gpu-class"
+        assert back.disruption.consolidation_policy == "WhenEmpty"
+        assert back.disruption.consolidate_after_s == 30
+        assert back.limits[CPU] == 100_000
+        assert back.limits[MEMORY] == 2**40
+        assert back.weight == 10
+
+    def test_expire_never(self):
+        m = nodepool_to_manifest(NodePool())
+        assert m["spec"]["disruption"]["expireAfter"] == "Never"
+        assert nodepool_from_manifest(m).disruption.expire_after_s is None
+
+    def test_duration_units(self):
+        m = nodepool_to_manifest(NodePool())
+        m["spec"]["disruption"]["expireAfter"] = "12h"
+        assert nodepool_from_manifest(m).disruption.expire_after_s == 43200
+
+
+class TestNodeClassRoundtrip:
+    def test_roundtrip(self):
+        nc = NodeClass(name="gpu-class", image_family="config",
+                       subnet_selector={"team": "x"},
+                       security_group_selector={"cluster": "k"},
+                       image_selector={"id": "img-5"},
+                       role="node-role", user_data="settings",
+                       tags={"env": "prod"}, block_device_gib=100)
+        back = nodeclass_from_manifest(nodeclass_to_manifest(nc))
+        assert back == nc
+
+    def test_schemas_validate_shapes(self):
+        schemas = crd_schemas()
+        assert set(schemas) == {"NodePool", "NodeClass"}
+        # sanity: generated manifests carry the right top-level keys
+        m = nodepool_to_manifest(NodePool())
+        assert set(schemas["NodePool"]["required"]) <= set(m)
+        json.dumps(schemas)  # schemas are serializable documents
+
+
+class TestLegacyConversion:
+    PROVISIONER = {
+        "apiVersion": "karpenter.tpu/v1alpha5",
+        "kind": "Provisioner",
+        "metadata": {"name": "default"},
+        "spec": {
+            "labels": {"team": "ml"},
+            "requirements": [
+                {"key": wk.CAPACITY_TYPE, "operator": "In", "values": ["spot"]}],
+            "taints": [{"key": "gpu", "effect": "NoSchedule", "value": "true"}],
+            "providerRef": {"name": "my-template"},
+            "ttlSecondsAfterEmpty": 30,
+            "ttlSecondsUntilExpired": 2592000,
+            "limits": {"resources": {"cpu": "100", "memory": "400Gi"}},
+            "weight": 20,
+        },
+    }
+    NODE_TEMPLATE = {
+        "apiVersion": "karpenter.tpu/v1alpha1",
+        "kind": "NodeTemplate",
+        "metadata": {"name": "my-template"},
+        "spec": {
+            "amiFamily": "Bottlerocket",
+            "subnetSelector": {"karpenter.sh/discovery": "cluster"},
+            "securityGroupSelector": {"karpenter.sh/discovery": "cluster"},
+            "amiSelector": {"team": "ml"},
+            "role": "KarpenterNodeRole",
+            "userData": 'k = "v"',
+            "blockDeviceMappings": [
+                {"deviceName": "/dev/xvda", "ebs": {"volumeSize": "100Gi"}}],
+        },
+    }
+
+    def test_provisioner_to_nodepool(self):
+        m = convert_provisioner(self.PROVISIONER)
+        assert m["kind"] == "NodePool"
+        pool = nodepool_from_manifest(m)
+        assert pool.template.labels == {"team": "ml"}
+        assert pool.template.node_class_ref == "my-template"
+        assert pool.disruption.consolidation_policy == "WhenEmpty"
+        assert pool.disruption.consolidate_after_s == 30
+        assert pool.disruption.expire_after_s == 2592000
+        assert pool.limits[CPU] == 100_000
+        assert pool.weight == 20
+        assert any(t.key == "gpu" for t in pool.template.taints)
+
+    def test_consolidation_enabled_wins(self):
+        p = dict(self.PROVISIONER, spec={
+            **self.PROVISIONER["spec"], "consolidation": {"enabled": True}})
+        pool = nodepool_from_manifest(convert_provisioner(p))
+        assert pool.disruption.consolidation_policy == "WhenUnderutilized"
+
+    def test_node_template_to_nodeclass(self):
+        m = convert_node_template(self.NODE_TEMPLATE)
+        assert m["kind"] == "NodeClass"
+        nc = nodeclass_from_manifest(m)
+        assert nc.image_family == "config"       # Bottlerocket → config
+        assert nc.subnet_selector == {"karpenter.sh/discovery": "cluster"}
+        assert nc.image_selector == {"team": "ml"}
+        assert nc.role == "KarpenterNodeRole"
+        assert nc.block_device_gib == 100
+
+    def test_dispatch_and_passthrough(self):
+        assert convert_manifest(self.PROVISIONER)["kind"] == "NodePool"
+        current = nodepool_to_manifest(NodePool())
+        assert convert_manifest(current) is current
+        with pytest.raises(ValueError):
+            convert_manifest({"kind": "Deployment"})
+
+    def test_convert_tool_cli(self, tmp_path):
+        src = tmp_path / "legacy.yaml"
+        src.write_text(yaml.safe_dump_all([self.PROVISIONER,
+                                           self.NODE_TEMPLATE]))
+        out = subprocess.run(
+            [sys.executable, "tools/convert.py", "-f", str(src)],
+            capture_output=True, text=True, cwd="/root/repo", check=True)
+        docs = list(yaml.safe_load_all(out.stdout))
+        assert [d["kind"] for d in docs] == ["NodePool", "NodeClass"]

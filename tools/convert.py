@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""karpenter-tpu-convert: migrate legacy (v1alpha) manifests to the current
+API — the analog of the reference's karpenter-convert
+(/root/reference/tools/karpenter-convert/README.md:1-10).
+
+Usage:
+    python tools/convert.py -f old.yaml            # converted YAML on stdout
+    python tools/convert.py -f old.yaml -o new.yaml
+    cat old.yaml | python tools/convert.py         # stdin
+
+Multi-document YAML streams convert document by document; unknown kinds
+fail loudly unless --ignore-unknown is given.
+"""
+
+import argparse
+import sys
+
+import yaml
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+    from karpenter_tpu.api.legacy import convert_manifest
+
+    p = argparse.ArgumentParser(prog="karpenter-tpu-convert")
+    p.add_argument("-f", "--filename", default="-",
+                   help="input manifest file ('-' == stdin)")
+    p.add_argument("-o", "--output", default="-",
+                   help="output file ('-' == stdout)")
+    p.add_argument("--ignore-unknown", action="store_true",
+                   help="pass through kinds the converter does not know")
+    ns = p.parse_args(argv)
+
+    raw = sys.stdin.read() if ns.filename == "-" else open(ns.filename).read()
+    docs = [d for d in yaml.safe_load_all(raw) if d]
+    out_docs = []
+    for doc in docs:
+        try:
+            out_docs.append(convert_manifest(doc))
+        except ValueError:
+            if ns.ignore_unknown:
+                out_docs.append(doc)
+            else:
+                raise
+    text = yaml.safe_dump_all(out_docs, sort_keys=False)
+    if ns.output == "-":
+        sys.stdout.write(text)
+    else:
+        with open(ns.output, "w") as f:
+            f.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
