@@ -1,0 +1,30 @@
+# Developer entry points (reference: /root/reference/Makefile:68-109).
+
+PYTEST ?= python -m pytest
+
+.PHONY: test scale-test benchmark benchmark-interruption deflake native clean help
+
+help: ## Show targets
+	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
+
+test: ## Unit/behavior suites (virtual 8-device CPU mesh)
+	$(PYTEST) tests/ -q
+
+scale-test: ## The in-process scale suite only
+	$(PYTEST) tests/test_scale.py -q
+
+benchmark: ## Headline solve benchmark (one JSON line on stdout)
+	python bench.py
+
+benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
+	python benchmarks/interruption_benchmark.py
+
+deflake: ## Run the suite 5x to shake out order/timing flakes (Makefile:106-109)
+	for i in 1 2 3 4 5; do $(PYTEST) tests/ -q -p no:randomly || exit 1; done
+
+native: ## Force-rebuild the C++ runtime components
+	python -c "from karpenter_tpu import native; assert native.build(force=True)"
+
+clean:
+	rm -rf .pytest_cache karpenter_tpu/native/_libffd.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -63,6 +63,40 @@ def time_solve(prob, iters=5):
     return float(np.median(times)), r
 
 
+def cost_lower_bound(prob):
+    """LP-relaxation lower bound on achievable cost: for every resource r,
+    any packing pays at least total_demand_r x the best price-per-unit-r
+    across launchable options; the max over resources is a valid bound
+    (BASELINE.md packing-cost-vs-optimal target)."""
+    if prob.num_options == 0 or prob.num_classes == 0:
+        return 0.0
+    # demand counts only classes with a compatible option — infeasible pods
+    # never enter total_price, so including them would inflate the bound and
+    # could report cost ratios below 1
+    feas_cls = prob.class_compat.any(axis=1)
+    demand = (prob.class_requests[feas_cls]
+              * prob.class_counts[feas_cls, None]).sum(axis=0)
+    alloc, price = prob.option_alloc, prob.option_price
+    lb = 0.0
+    for r in range(alloc.shape[1]):
+        col = alloc[:, r]
+        ok = col > 0
+        if ok.any() and demand[r] > 0:
+            lb = max(lb, float(demand[r]) * float((price[ok] / col[ok]).min()))
+    # tighter per-pod fractional bound: a pod of class c occupies at least
+    # share_j = max_r(req_r / alloc_jr) of an option-j node, so it costs at
+    # least min over compatible j of price_j * share_j
+    with np.errstate(divide="ignore", invalid="ignore"):
+        shares = np.where(alloc[None, :, :] > 0,
+                          prob.class_requests[:, None, :] / alloc[None, :, :],
+                          np.inf).max(axis=2)                    # C x O
+    per_pod = np.where(prob.class_compat, price[None, :] * shares, np.inf)
+    best = per_pod.min(axis=1)                                   # C
+    feasible = np.isfinite(best)
+    lb2 = float((best[feasible] * prob.class_counts[feasible]).sum())
+    return max(lb, lb2)
+
+
 def run_config(name, pods, n_types, pools=None, iters=5):
     from karpenter_tpu.api.objects import NodePool
     from karpenter_tpu.catalog.generate import generate_catalog
@@ -73,10 +107,13 @@ def run_config(name, pods, n_types, pools=None, iters=5):
     prob = tensorize(pods, catalog, pools or [NodePool()])
     t_tensorize = (time.perf_counter() - t0) * 1000
     p50, r = time_solve(prob, iters)
+    lb = cost_lower_bound(prob)
+    ratio = (r.total_price / lb) if lb > 0 else float("nan")
     log(f"[{name}] pods={len(pods)} types={n_types} classes={prob.num_classes} "
         f"options={prob.num_options} tensorize={t_tensorize:.0f}ms "
         f"solve_p50={p50:.1f}ms nodes={len(r.nodes)} "
-        f"cost=${r.total_price:.2f}/h unsched={len(r.unschedulable)}")
+        f"cost=${r.total_price:.2f}/h (lb ${lb:.2f}, x{ratio:.3f}) "
+        f"unsched={len(r.unschedulable)}")
     return p50, t_tensorize
 
 

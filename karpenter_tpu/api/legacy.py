@@ -15,8 +15,7 @@ from typing import Dict, List, Optional
 from .objects import Disruption, NodeClass, NodePool, NodePoolTemplate
 from .requirements import Requirements
 from .resources import ResourceList
-from .serialize import (GROUP, VERSION, _parse_duration, _selector_from_terms,
-                        nodeclass_to_manifest, nodepool_to_manifest,
+from .serialize import (nodeclass_to_manifest, nodepool_to_manifest,
                         requirement_from_dict, taint_from_dict)
 
 LEGACY_GROUP = "karpenter.tpu"
@@ -76,9 +75,12 @@ def convert_node_template(m: Dict) -> Dict:
     bdm = spec.get("blockDeviceMappings", [])
     gib = 20
     if bdm:
-        size = str(bdm[0].get("ebs", bdm[0]).get("volumeSize", "20Gi"))
-        gib = int(float(size.rstrip("Gi"))) if size.endswith("Gi") \
-            else int(float(size))
+        from .resources import EPHEMERAL_STORAGE, parse_quantity
+        size = bdm[0].get("ebs", bdm[0]).get("volumeSize", "20Gi")
+        if isinstance(size, (int, float)):
+            gib = max(1, int(size))  # bare numbers mean GiB in EBS specs
+        else:
+            gib = max(1, round(parse_quantity(size, EPHEMERAL_STORAGE) / 2**30))
     family_map = {"AL2": "standard", "Bottlerocket": "config",
                   "Custom": "custom"}
     family = spec.get("amiFamily", "standard")

@@ -14,12 +14,14 @@ Re-implements the L3/L2 surface of the reference:
 from __future__ import annotations
 
 import copy
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api import labels as wk
 from ..api.objects import NodeClaim, NodeClass, NodePool
+from ..api.taints import Taint
 from ..api.requirements import IN, Requirement, Requirements
 from ..api.resources import ResourceList
 from ..catalog.instancetype import InstanceType, Offering
@@ -241,6 +243,12 @@ class CloudProvider:
             "karpenter.sh/nodeclaim": claim.name,
             "Name": f"{claim.nodepool}/{claim.name}",
         }
+        if claim.taints:
+            # taints ride along as a tag so restart hydration can restore
+            # them (cloud tags are the durable store, SURVEY §5.4)
+            tags["karpenter.sh/taints"] = json.dumps(
+                [{"key": t.key, "effect": t.effect, "value": t.value}
+                 for t in claim.taints])
         result = self.cloud.create_fleet(overrides, count=1, tags=tags)
         # settle the in-flight IP predictions against where the launch landed
         # (subnet.go UpdateInflightIPs:149)
@@ -314,12 +322,24 @@ class CloudProvider:
         if known is not None:
             return known
         claim = NodeClaim(nodepool=inst.tags.get("karpenter.sh/nodepool", ""))
+        # restore the durable identity from tags (cloud tags are the durable
+        # store — SURVEY §5.4; reference restores machine identity the same
+        # way via its Link hook)
+        if inst.tags.get("karpenter.sh/nodeclaim"):
+            claim.name = inst.tags["karpenter.sh/nodeclaim"]
         claim.provider_id = inst.id
         claim.instance_type = inst.instance_type
         claim.zone = inst.zone
         claim.capacity_type = inst.capacity_type
         claim.price = inst.price
         claim.launched_at = inst.launched_at
+        # labels/taints must survive hydration or recovered nodes reject
+        # every selector/affinity pod (compat fails closed on absent keys)
+        claim.labels.update(self._instance_labels(inst, claim))
+        taints_json = inst.tags.get("karpenter.sh/taints")
+        if taints_json:
+            claim.taints = [Taint(d["key"], d["effect"], d.get("value", ""))
+                            for d in json.loads(taints_json)]
         return claim
 
     def is_drifted(self, claim: NodeClaim, nodepool: Optional[NodePool] = None) -> Optional[str]:

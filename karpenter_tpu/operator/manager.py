@@ -86,6 +86,14 @@ class LeaderElector:
         try:
             fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
+            # stale-lock recovery: a holder that crashed mid-update would
+            # otherwise deadlock election forever — break locks older than
+            # the lease TTL (wall-clock mtime; the lock is held for µs)
+            try:
+                if time.time() - os.path.getmtime(lock) > self.ttl:
+                    os.unlink(lock)
+            except OSError:
+                pass
             return self.is_leader()  # someone else is mid-update
         try:
             now = self.clock()
@@ -233,6 +241,21 @@ class ControllerManager:
                 if self.path == "/metrics":
                     body = metrics.REGISTRY.expose().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/debug/pprof"):
+                    # profiling surface behind --enable-profiling
+                    # (reference settings.md:23); all-thread stack dump
+                    if not manager.operator.options.enable_profiling:
+                        self.send_response(403)
+                        self.end_headers()
+                        return
+                    import sys
+                    import traceback
+                    lines = []
+                    for tid, frame in sys._current_frames().items():
+                        lines.append(f"--- thread {tid} ---")
+                        lines.extend(traceback.format_stack(frame))
+                    body = "".join(lines).encode()
+                    ctype = "text/plain"
                 elif self.path in ("/healthz", "/readyz"):
                     ok = manager.operator.cloud_provider.liveness_probe()
                     body = (b"ok" if ok else b"unhealthy")

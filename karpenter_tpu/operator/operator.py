@@ -110,6 +110,33 @@ class Operator:
             node_classes=self.node_classes,
             cluster_name=self.options.cluster_name, clock=clock,
             subnets=self.subnets, launch_templates=self.launch_templates)
+        self.hydrate_cluster()
+
+    def hydrate_cluster(self) -> int:
+        """Restart recovery: rebuild NodeClaims + Nodes from the cloud's
+        cluster-tagged instances BEFORE any controller runs — without this a
+        fresh process would see its whole live fleet as leaked capacity and
+        the GC sweep would terminate it.  Durable state lives in cloud tags
+        (SURVEY §5.4: restart = rebuild caches from List calls; the
+        reference's Link hook + hydrateCache).  Pod bindings live in the
+        cluster API, not the cloud, so hydrated nodes start empty and fill
+        as pods re-observe."""
+        catalog_by_name = {it.name: it for it in self.catalog}
+        n = 0
+        for claim in self.cloud_provider.list():
+            if self.cluster.claim_for_provider_id(claim.provider_id):
+                continue
+            it = catalog_by_name.get(claim.instance_type)
+            allocatable = it.allocatable if it else claim.requests
+            claim.created_at = claim.created_at or claim.launched_at
+            node = self.cluster.register_nodeclaim(
+                claim, allocatable, it.capacity if it else None)
+            # recovered nodes keep their original age so expiry still works
+            node.created_at = claim.launched_at or node.created_at
+            n += 1
+        if n:
+            log.info("hydrated %d nodes from cloud state", n)
+        return n
 
 
 def build_controllers(op: Operator) -> Dict[str, object]:

@@ -24,6 +24,13 @@ DEFAULT_METRICS_PORT = 8000
 DEFAULT_HEALTH_PORT = 8081
 
 
+def _parse_kv_list(raw: str, into: Dict, cast=lambda v: v) -> None:
+    """Parse `k=v,k2=v2` option strings into a dict (feature gates, tags)."""
+    for item in filter(None, raw.split(",")):
+        k, _, v = item.partition("=")
+        into[k.strip()] = cast(v.strip())
+
+
 @dataclass
 class Options:
     cluster_name: str = "default"
@@ -37,6 +44,7 @@ class Options:
     metrics_port: int = DEFAULT_METRICS_PORT
     health_port: int = DEFAULT_HEALTH_PORT
     leader_elect: bool = False
+    enable_profiling: bool = False   # settings.md:23 --enable-profiling
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True})
     tags: Dict[str, str] = field(default_factory=dict)
@@ -69,6 +77,8 @@ class Options:
                        default=env.get("health_port", DEFAULT_HEALTH_PORT))
         p.add_argument("--leader-elect", action="store_true",
                        default=env.get("leader_elect", False))
+        p.add_argument("--enable-profiling", action="store_true",
+                       default=env.get("enable_profiling", False))
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -84,17 +94,14 @@ class Options:
             metrics_port=ns.metrics_port,
             health_port=ns.health_port,
             leader_elect=ns.leader_elect,
+            enable_profiling=ns.enable_profiling,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
-        for gate in filter(None, str(env.get("feature_gates", "")).split(",")):
-            name, _, value = gate.partition("=")
-            opts.feature_gates[name.strip()] = value.strip().lower() != "false"
-        for tag in filter(None, str(env.get("tags", "")).split(",")):
-            k, _, v = tag.partition("=")
-            opts.tags[k.strip()] = v.strip()
-        for gate in filter(None, ns.feature_gates.split(",")):
-            name, _, value = gate.partition("=")
-            opts.feature_gates[name.strip()] = value.strip().lower() != "false"
+        _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
+                       cast=lambda v: v.lower() != "false")
+        _parse_kv_list(str(env.get("tags", "")), opts.tags)
+        _parse_kv_list(ns.feature_gates, opts.feature_gates,
+                       cast=lambda v: v.lower() != "false")
         return opts
 
     @staticmethod
@@ -103,6 +110,7 @@ class Options:
         casts = {
             "isolated_network": lambda v: v.lower() == "true",
             "leader_elect": lambda v: v.lower() == "true",
+            "enable_profiling": lambda v: v.lower() == "true",
             "vm_memory_overhead_percent": float,
             "reserved_enis": int,
             "batch_idle_duration": float,

@@ -109,7 +109,8 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
     # axis annotations) stay consistent between init and body outputs
     (slot_option, slot_used, n_open, n_unsched), takes = jax.lax.scan(
         step, (init_option, init_used, n_open0, jnp.zeros_like(n_open0)),
-        (requests, counts, compat, node_cap))
+        (requests, counts, compat, node_cap),
+        unroll=8)  # amortize per-step sequencing overhead on TPU
     return slot_option, slot_used, n_open, n_unsched, takes
 
 
@@ -137,6 +138,32 @@ def class_pack_aggregate_kernel(requests, counts, compat, node_cap,
     head = jnp.stack([total_cost, n_open.astype(jnp.float32),
                       n_unsched.astype(jnp.float32)])
     return jnp.concatenate([head, nodes_per_option])
+
+
+@partial(jax.jit, static_argnames=("max_nodes", "emit_takes"))
+def class_pack_kernel_packed(requests, counts, compat_packed, node_cap,
+                             alloc, price, rank, init_option, init_used,
+                             max_nodes: int, emit_takes: bool = False):
+    """class_pack_kernel taking a bit-packed compat matrix (uint8, packbits
+    along options).  The C×O bool mask dominates host→device transfer on
+    tunneled TPUs; shipping bits cuts it 8× and the unpack fuses into the
+    compiled program."""
+    compat = jnp.unpackbits(compat_packed, axis=1,
+                            count=alloc.shape[0]).astype(bool)
+    return class_pack_kernel(requests, counts, compat, node_cap, alloc,
+                             price, rank, init_option, init_used,
+                             max_nodes, emit_takes)
+
+
+@partial(jax.jit, static_argnames=("max_nodes",))
+def class_pack_aggregate_kernel_packed(requests, counts, compat_packed,
+                                       node_cap, alloc, price, rank,
+                                       init_option, init_used, max_nodes: int):
+    compat = jnp.unpackbits(compat_packed, axis=1,
+                            count=alloc.shape[0]).astype(bool)
+    return class_pack_aggregate_kernel(requests, counts, compat, node_cap,
+                                       alloc, price, rank, init_option,
+                                       init_used, max_nodes)
 
 
 def _sorted_classes(problem: Problem, extra_compat: Optional[np.ndarray]):
@@ -187,7 +214,7 @@ def solve_classpack(problem: Problem,
     # pad class axis AND option axis so catalog/ICE/cluster deltas reuse
     # compiled programs
     Cpad = pad_to(C, (64, 256, 1024, 4096))
-    Opad = pad_to(alloc.shape[0], (512, 2048, 8192, 32768))
+    Opad = pad_to(alloc.shape[0], (512, 2048, 4096, 8192, 32768))
     req_p = np.zeros((Cpad, R), np.int32)
     req_p[:C] = requests.astype(np.int32)
     cnt_p = np.zeros(Cpad, np.int32)
@@ -215,7 +242,8 @@ def solve_classpack(problem: Problem,
             init_used[:E] = np.ceil(existing_used).astype(np.int32)
 
     kernel_args = (
-        jnp.asarray(req_p), jnp.asarray(cnt_p), jnp.asarray(comp_p),
+        jnp.asarray(req_p), jnp.asarray(cnt_p),
+        jnp.asarray(np.packbits(comp_p, axis=1)),
         jnp.asarray(cap_p),
         jnp.asarray(alloc.astype(np.int32)), jnp.asarray(price),
         jnp.asarray(rank),
@@ -223,7 +251,7 @@ def solve_classpack(problem: Problem,
 
     if not decode:
         # aggregate path: ONE device→host transfer of the launch plan
-        flat = np.asarray(class_pack_aggregate_kernel(*kernel_args, K))
+        flat = np.asarray(class_pack_aggregate_kernel_packed(*kernel_args, K))
         total, n_open, n_unsched = float(flat[0]), int(flat[1]), int(flat[2])
         nodes_per_option = flat[3:3 + O].astype(np.int64)
         nodes = [NodeDecision(option=problem.options[oi], pod_indices=[])
@@ -231,7 +259,7 @@ def solve_classpack(problem: Problem,
         return PackingResult(nodes=nodes, unschedulable=[None] * n_unsched,
                              existing_assignments={}, total_price=total)
 
-    slot_option, slot_used, n_open, n_unsched, takes = class_pack_kernel(
+    slot_option, slot_used, n_open, n_unsched, takes = class_pack_kernel_packed(
         *kernel_args, K, True)
     slot_option, slot_used, n_unsched, takes = jax.device_get(
         (slot_option, slot_used, n_unsched, takes))

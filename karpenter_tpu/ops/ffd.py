@@ -189,7 +189,7 @@ def solve_ffd(problem: Problem,
     # pad both the pod axis and the option axis (columns) so catalog/ICE/
     # cluster-size changes reuse compiled programs instead of recompiling
     Ppad = pad_to(P)
-    Opad = pad_to(alloc.shape[0], (512, 2048, 8192, 32768))
+    Opad = pad_to(alloc.shape[0], (512, 2048, 4096, 8192, 32768))
     req_p = np.zeros((Ppad, R), np.float32)
     req_p[:P] = requests
     comp_p = np.zeros((Ppad, Opad), bool)

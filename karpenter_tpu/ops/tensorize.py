@@ -251,21 +251,35 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
     C = len(reps)
     class_requests = np.zeros((C, R), np.float32)
     class_compat = np.zeros((C, O), bool)
+    # compat rows depend only on the class's constraint shape (branches +
+    # tolerations), not its resources — many classes share one shape, so the
+    # O(C×O) Python loop collapses to O(distinct-shapes × O)
+    compat_memo: dict = {}
     for ci, rep in enumerate(reps):
         req = ResourceList(rep.requests)
         req[PODS] = req.get(PODS, 0) + 1  # every pod consumes one pod slot
         class_requests[ci] = req.to_vector(axes, DEFAULT_SCALES, round_up=True)
         branches = rep.scheduling_requirements()
-        for j in range(O):
-            if not tolerates_all(rep.tolerations, option_taints[j]):
-                continue
-            # Fail closed on keys the option can't provide: a pod requiring a
-            # user label schedules only if some NodePool template carries it
-            # (reference scheduling.md label rules); complemented ops (NotIn/
-            # DoesNotExist) tolerate absence via Requirements.compatible.
-            provided = option_reqs[j]
-            if any(b.compatible(provided) for b in branches):
-                class_compat[ci, j] = True
+        sig = (tuple(tuple(sorted((k, repr(r)) for k, r in b.items()))
+                     for b in branches),
+               tuple(sorted((t.key, t.operator, t.value, t.effect)
+                            for t in rep.tolerations)))
+        row = compat_memo.get(sig)
+        if row is None:
+            row = np.zeros(O, bool)
+            for j in range(O):
+                if not tolerates_all(rep.tolerations, option_taints[j]):
+                    continue
+                # Fail closed on keys the option can't provide: a pod
+                # requiring a user label schedules only if some NodePool
+                # template carries it (reference scheduling.md label rules);
+                # complemented ops (NotIn/DoesNotExist) tolerate absence via
+                # Requirements.compatible.
+                provided = option_reqs[j]
+                if any(b.compatible(provided) for b in branches):
+                    row[j] = True
+            compat_memo[sig] = row
+        class_compat[ci] = row
 
     return Problem(
         axes=axes,

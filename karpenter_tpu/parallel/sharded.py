@@ -101,7 +101,7 @@ def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
     Cpad = pad_to(C, (64, 256, 1024, 4096))
     R = len(problem.axes)
     O = problem.num_options
-    Opad = pad_to(O, (512, 2048, 8192))
+    Opad = pad_to(O, (512, 2048, 4096, 8192))
 
     requests = np.zeros((Cpad, R), np.int32)
     requests[:C] = problem.class_requests[order].astype(np.int32)

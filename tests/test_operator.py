@@ -3,6 +3,7 @@ manager ticks, batch windows, endpoints, leader election
 (reference: pkg/operator/ + pkg/operator/options/ + cmd/controller/main.go)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import pytest
@@ -193,3 +194,124 @@ class TestControllerManager:
         clock[0] += 5
         assert follower.tick() == {}           # not leader → no work
         assert not op.cloud.running()
+
+
+def _seed_cloud(op):
+    op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 100, {}),
+                        SubnetInfo("s-b", "zone-b", 100, {})]
+    op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+    op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+    op.params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    return op
+
+
+class TestRestartRecovery:
+    def test_restart_hydrates_fleet_instead_of_gc_killing_it(self):
+        clock = [1000.0]
+        op1 = _seed_cloud(Operator(Options(), catalog=generate_catalog(10),
+                                   clock=lambda: clock[0]))
+        mgr1 = ControllerManager(op1, build_controllers(op1),
+                                 clock=lambda: clock[0])
+        op1.cluster.add_pods([pod() for _ in range(6)])
+        mgr1.tick()        # opens the pod batch window
+        clock[0] += 1.1
+        res = mgr1.tick()  # window ripe → provision
+        launched = {c.provider_id for c in res["provisioning"].launched}
+        assert launched
+        clock[0] += 120  # well past the GC registration grace period
+
+        # process restart: new operator over the SAME cloud substrate
+        op2 = Operator(Options(), cloud=op1.raw_cloud,
+                       catalog=generate_catalog(10), clock=lambda: clock[0])
+        assert {n.provider_id for n in op2.cluster.nodes.values()} == launched
+        # claim identity restored from durable tags
+        names1 = set(op1.cluster.nodeclaims)
+        assert set(op2.cluster.nodeclaims) == names1
+        # GC sweep on the fresh process must not touch the live fleet
+        ctrls = build_controllers(op2)
+        gc_res = ctrls["garbagecollection"].reconcile()
+        assert gc_res.leaked_instances == []
+        assert gc_res.orphaned_nodes == []
+        assert len(op2.raw_cloud.running()) == len(launched)
+
+    def test_hydrated_nodes_keep_age_for_expiry(self):
+        clock = [1000.0]
+        op1 = _seed_cloud(Operator(Options(), catalog=generate_catalog(5),
+                                   clock=lambda: clock[0]))
+        mgr1 = ControllerManager(op1, build_controllers(op1),
+                                 clock=lambda: clock[0])
+        op1.cluster.add_pods([pod()])
+        mgr1.tick()
+        clock[0] += 1.1
+        mgr1.tick()
+        clock[0] += 5000
+        op2 = Operator(Options(), cloud=op1.raw_cloud,
+                       catalog=generate_catalog(5), clock=lambda: clock[0])
+        node = next(iter(op2.cluster.nodes.values()))
+        assert clock[0] - node.created_at >= 5000  # age survived the restart
+
+    def test_hydration_is_idempotent(self):
+        clock = [1000.0]
+        op = _seed_cloud(Operator(Options(), catalog=generate_catalog(5),
+                                  clock=lambda: clock[0]))
+        mgr = ControllerManager(op, build_controllers(op),
+                                clock=lambda: clock[0])
+        op.cluster.add_pods([pod()])
+        mgr.tick()
+        clock[0] += 1.1
+        mgr.tick()
+        before = len(op.cluster.nodes)
+        assert op.hydrate_cluster() == 0  # live claims not duplicated
+        assert len(op.cluster.nodes) == before
+
+
+class TestParityExtras:
+    def test_profiling_endpoint_gated(self):
+        clock = [100.0]
+        op = _seed_cloud(Operator(Options(), catalog=generate_catalog(5),
+                                  clock=lambda: clock[0]))
+        mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/pprof", timeout=5)
+            assert e.value.code == 403
+            op.options.enable_profiling = True
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/pprof", timeout=5).read()
+            assert b"thread" in body
+        finally:
+            mgr.stop()
+
+    def test_hydrated_nodes_keep_labels_and_taints(self):
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.api.objects import NodePool, NodePoolTemplate
+        from karpenter_tpu.api.taints import Taint
+        clock = [1000.0]
+        op1 = _seed_cloud(Operator(Options(), catalog=generate_catalog(5),
+                                   clock=lambda: clock[0]))
+        pool = NodePool(template=NodePoolTemplate(
+            taints=[Taint("dedicated", "NoSchedule", "ml")]))
+        op1.nodepools["default"] = pool
+        mgr1 = ControllerManager(op1, build_controllers(op1),
+                                 clock=lambda: clock[0])
+        op1.cluster.add_pods([Pod(requests=ResourceList(
+            {CPU: 500, MEMORY: 512 * 2**20}),
+            tolerations=[__import__("karpenter_tpu.api.taints",
+                                    fromlist=["Toleration"]).Toleration(
+                "dedicated", "Exists")])])
+        mgr1.tick()
+        clock[0] += 1.1
+        mgr1.tick()
+        node1 = next(iter(op1.cluster.nodes.values()))
+        assert any(t.key == "dedicated" for t in node1.taints)
+        # restart
+        op2 = Operator(Options(), cloud=op1.raw_cloud,
+                       catalog=generate_catalog(5), clock=lambda: clock[0])
+        node2 = next(iter(op2.cluster.nodes.values()))
+        assert node2.labels.get(wk.INSTANCE_TYPE) == node1.instance_type
+        assert node2.labels.get(wk.ZONE) == node1.zone
+        assert any(t.key == "dedicated" and t.value == "ml"
+                   for t in node2.taints)

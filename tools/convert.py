@@ -13,13 +13,15 @@ fail loudly unless --ignore-unknown is given.
 """
 
 import argparse
+import os
 import sys
 
 import yaml
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main(argv=None) -> int:
-    sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
     from karpenter_tpu.api.legacy import convert_manifest
 
     p = argparse.ArgumentParser(prog="karpenter-tpu-convert")
