@@ -226,6 +226,17 @@ class NodePool:
         return all(in_use.get(k, 0) < v for k, v in self.limits.items()) if self.limits else True
 
 
+def pool_view(nodepools) -> Dict[str, "NodePool"]:
+    """Normalize a controller's nodepools argument.  A dict is adopted BY
+    REFERENCE — the single live registry `Operator.apply()` mutates, shared
+    across controllers so applied pools take effect without rebuilds.  A
+    sequence is snapshotted (test convenience).  This is the one place that
+    contract lives."""
+    if isinstance(nodepools, dict):
+        return nodepools
+    return {p.name: p for p in nodepools}
+
+
 @dataclass
 class NodeClaim:
     """The unit of provisioning: scheduler emits it, cloud provider fulfils it

@@ -23,8 +23,9 @@ from ..api import labels as wk
 from ..api.objects import NodeClaim, NodeClass, NodePool
 from ..api.taints import Taint
 from ..api.requirements import IN, Requirement, Requirements
-from ..api.resources import ResourceList
+from ..api.resources import CPU, MEMORY, ResourceList
 from ..catalog.instancetype import InstanceType, Offering
+from ..utils import metrics
 from .cache import UnavailableOfferings
 from .fake import CloudError, FakeCloud, FleetOverride, FleetResult, ICE_CODE
 
@@ -48,19 +49,44 @@ class NodeClassNotFoundError(InsufficientCapacityError):
 @dataclass
 class InstanceTypesProvider:
     """Catalog provider with ICE masking + memoization keyed on the
-    unavailable-offerings sequence number (instancetype.go:114-124)."""
+    unavailable-offerings sequence number (instancetype.go:114-124).
+    With a pricing provider wired, offering prices come from the live
+    on-demand/spot tables instead of the catalog's static values
+    (createOfferings price lookup, instancetype.go:144-175)."""
     base_catalog: List[InstanceType]
     unavailable: UnavailableOfferings
-    _memo: Tuple[int, List[InstanceType]] = field(default=None, repr=False)
+    pricing: object = None  # providers.pricing.PricingProvider, optional
+    _memo: Tuple[tuple, List[InstanceType]] = field(default=None, repr=False)
+
+    def _offering_price(self, it: InstanceType, o: Offering,
+                        use_live: bool) -> float:
+        # until the first live refresh the catalog's own (zone- and
+        # capacity-type-differentiated) prices are authoritative — the
+        # pricing provider's static fallback is a lossy per-type min
+        if not use_live:
+            return o.price
+        if o.capacity_type == wk.CAPACITY_TYPE_SPOT:
+            p = self.pricing.spot_price(it.name, o.zone)
+        else:
+            p = self.pricing.on_demand_price(it.name)
+        return o.price if p is None else p
 
     def list(self) -> List[InstanceType]:
-        seq = self.unavailable.seq_num
-        if self._memo is not None and self._memo[0] == seq:
+        # the pricing seq is read ONCE per rebuild: it keys the memo and
+        # decides whether live prices apply, so a refresh landing mid-rebuild
+        # just invalidates the next lookup instead of mixing tables
+        price_seq = 0 if self.pricing is None else self.pricing.seq_num
+        key = (self.unavailable.seq_num, price_seq)
+        if self._memo is not None and self._memo[0] == key:
             return self._memo[1]
+        use_live = price_seq > 0
         out = []
+        cpu_gauge = metrics.instance_type_cpu()
+        mem_gauge = metrics.instance_type_memory()
         for it in self.base_catalog:
             offerings = [
-                Offering(o.zone, o.capacity_type, o.price,
+                Offering(o.zone, o.capacity_type,
+                         self._offering_price(it, o, use_live),
                          available=o.available and not self.unavailable.is_unavailable(
                              o.capacity_type, it.name, o.zone))
                 for o in it.offerings
@@ -72,7 +98,12 @@ class InstanceTypesProvider:
                     kube_reserved=it.kube_reserved,
                     system_reserved=it.system_reserved,
                     eviction_threshold=it.eviction_threshold, info=it.info))
-        self._memo = (seq, out)
+                # cpu/mem gauges (pkg/providers/instancetype/metrics.go:35-46)
+                cpu_gauge.set(it.capacity.get(CPU, 0) / 1000.0,
+                              {"instance_type": it.name})
+                mem_gauge.set(it.capacity.get(MEMORY, 0),
+                              {"instance_type": it.name})
+        self._memo = (key, out)
         return out
 
 
@@ -146,10 +177,11 @@ class CloudProvider:
                  node_classes: Optional[Dict[str, NodeClass]] = None,
                  cluster_name: str = "default",
                  clock: Callable[[], float] = time.time,
-                 subnets=None, launch_templates=None):
+                 subnets=None, launch_templates=None, pricing=None):
         self.cloud = cloud
         self.unavailable = unavailable or UnavailableOfferings()
-        self.instance_types = InstanceTypesProvider(catalog, self.unavailable)
+        self.instance_types = InstanceTypesProvider(catalog, self.unavailable,
+                                                    pricing=pricing)
         self.node_classes = node_classes or {"default": NodeClass()}
         self.cluster_name = cluster_name
         self.clock = clock
@@ -249,6 +281,12 @@ class CloudProvider:
             tags["karpenter.sh/taints"] = json.dumps(
                 [{"key": t.key, "effect": t.effect, "value": t.value}
                  for t in claim.taints])
+        # user/template labels the catalog can't reconstruct (team=..., etc.)
+        # must also survive restarts or selector pods can't re-bind
+        custom = {k: v for k, v in claim.labels.items()
+                  if "kubernetes.io" not in k and not k.startswith("karpenter")}
+        if custom:
+            tags["karpenter.sh/labels"] = json.dumps(custom, sort_keys=True)
         result = self.cloud.create_fleet(overrides, count=1, tags=tags)
         # settle the in-flight IP predictions against where the launch landed
         # (subnet.go UpdateInflightIPs:149)
@@ -334,7 +372,11 @@ class CloudProvider:
         claim.price = inst.price
         claim.launched_at = inst.launched_at
         # labels/taints must survive hydration or recovered nodes reject
-        # every selector/affinity pod (compat fails closed on absent keys)
+        # every selector/affinity pod (compat fails closed on absent keys):
+        # custom labels come back from the tag, well-known from the catalog
+        labels_json = inst.tags.get("karpenter.sh/labels")
+        if labels_json:
+            claim.labels.update(json.loads(labels_json))
         claim.labels.update(self._instance_labels(inst, claim))
         taints_json = inst.tags.get("karpenter.sh/taints")
         if taints_json:

@@ -34,7 +34,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api import labels as wk
-from ..api.objects import Node, NodeClaim, NodePool, Pod
+from ..api.objects import Node, NodeClaim, NodePool, Pod, pool_view
 from ..api.resources import ResourceList
 from ..api.taints import NO_SCHEDULE, Taint
 from ..catalog.instancetype import InstanceType
@@ -118,7 +118,7 @@ class DisruptionController:
     """Single-action disruption loop over cluster state."""
 
     def __init__(self, provider: CloudProvider, cluster: Cluster,
-                 nodepools: Sequence[NodePool],
+                 nodepools,
                  clock: Callable[[], float] = time.time,
                  stabilization_s: float = DEFAULT_STABILIZATION_S,
                  drift_enabled: bool = True,
@@ -126,7 +126,7 @@ class DisruptionController:
                  terminator: Optional["TerminationController"] = None):
         self.provider = provider
         self.cluster = cluster
-        self.nodepools = {p.name: p for p in nodepools}
+        self.nodepools = pool_view(nodepools)
         self.clock = clock
         self.terminator = terminator
         self.stabilization_s = stabilization_s

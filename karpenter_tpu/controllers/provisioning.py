@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..api import labels as wk
-from ..api.objects import Node, NodeClaim, NodePool, Pod
+from ..api.objects import Node, NodeClaim, NodePool, Pod, pool_view
 from ..api.requirements import IN, Requirement, Requirements
 from ..api.resources import PODS, ResourceList
 from ..cloud.provider import (CloudProvider, InsufficientCapacityError,
@@ -93,12 +93,12 @@ class Provisioner:
     runtime; this is the per-batch solve)."""
 
     def __init__(self, provider: CloudProvider, cluster: Cluster,
-                 nodepools: Sequence[NodePool],
+                 nodepools,
                  clock: Callable[[], float] = time.time,
                  max_nodes_per_round: int = 2048):
         self.provider = provider
         self.cluster = cluster
-        self.nodepools = {p.name: p for p in nodepools}
+        self.nodepools = pool_view(nodepools)
         self.clock = clock
         self.max_nodes_per_round = max_nodes_per_round
 

@@ -79,23 +79,19 @@ class LeaderElector:
         self.clock = clock
 
     def try_acquire(self) -> bool:
-        """Read-decide-write under an exclusive lockfile so two replicas
-        racing at lease expiry cannot both win (the read-then-replace
-        without it is not atomic)."""
+        """Read-decide-write under a kernel flock so two replicas racing at
+        lease expiry cannot both win.  flock (not create/unlink) because the
+        kernel releases it automatically when the holder's fd closes — a
+        crash mid-update can neither deadlock election nor leave a stale
+        artifact another replica might delete out from under a live holder."""
+        import fcntl
         lock = f"{self.lease_path}.lock"
+        fd = os.open(lock, os.O_CREAT | os.O_WRONLY, 0o644)
         try:
-            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            # stale-lock recovery: a holder that crashed mid-update would
-            # otherwise deadlock election forever — break locks older than
-            # the lease TTL (wall-clock mtime; the lock is held for µs)
             try:
-                if time.time() - os.path.getmtime(lock) > self.ttl:
-                    os.unlink(lock)
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
             except OSError:
-                pass
-            return self.is_leader()  # someone else is mid-update
-        try:
+                return self.is_leader()  # someone else is mid-update
             now = self.clock()
             try:
                 with open(self.lease_path) as f:
@@ -111,8 +107,7 @@ class LeaderElector:
             os.replace(tmp, self.lease_path)
             return True
         finally:
-            os.close(fd)
-            os.unlink(lock)
+            os.close(fd)  # closing the fd releases the flock
 
     def is_leader(self) -> bool:
         try:

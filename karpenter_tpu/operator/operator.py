@@ -109,7 +109,8 @@ class Operator:
             self.batched_cloud, self.catalog, unavailable=self.unavailable,
             node_classes=self.node_classes,
             cluster_name=self.options.cluster_name, clock=clock,
-            subnets=self.subnets, launch_templates=self.launch_templates)
+            subnets=self.subnets, launch_templates=self.launch_templates,
+            pricing=self.pricing)
         self.hydrate_cluster()
 
     def hydrate_cluster(self) -> int:
@@ -138,21 +139,69 @@ class Operator:
             log.info("hydrated %d nodes from cloud state", n)
         return n
 
+    def apply(self, manifest: Dict):
+        """Admission-checked manifest ingestion — the kubectl-apply analog:
+        default + validate (webhook semantics, pkg/webhooks/webhooks.go:44-63)
+        and register into the live controller state (dict shared with the
+        provisioner/disruption controllers).  Legacy alpha kinds convert
+        first (karpenter-convert semantics).  Returns the registered object."""
+        from ..api.legacy import convert_manifest
+        from ..api.serialize import (nodeclass_from_manifest,
+                                     nodepool_from_manifest)
+        from ..controllers.nodeclass import (default_nodeclass,
+                                             validate_nodeclass,
+                                             validate_nodepool)
+        manifest = convert_manifest(manifest)
+        kind = manifest.get("kind")
+        if kind == "NodePool":
+            pool = nodepool_from_manifest(manifest)
+            validate_nodepool(pool)
+            self.nodepools[pool.name] = pool
+            log.info("applied NodePool %s", pool.name)
+            return pool
+        if kind == "NodeClass":
+            nc = default_nodeclass(nodeclass_from_manifest(manifest))
+            validate_nodeclass(nc)
+            self.node_classes[nc.name] = nc
+            log.info("applied NodeClass %s", nc.name)
+            return nc
+        raise ValueError(f"cannot apply kind {kind!r}")
+
+    def delete(self, kind: str, name: str) -> bool:
+        """Deregister a NodePool, or finalize + deregister a NodeClass
+        (deletion blocked while NodeClaims reference it — the finalizer
+        semantics, nodeclass/controller.go:100-126)."""
+        if kind == "NodePool":
+            return self.nodepools.pop(name, None) is not None
+        if kind == "NodeClass":
+            nc = self.node_classes.get(name)
+            if nc is None:
+                return False
+            from ..controllers.nodeclass import NodeClassController
+            ctrl = NodeClassController(
+                subnets=self.subnets, security_groups=self.security_groups,
+                images=self.images, instance_profiles=self.instance_profiles,
+                cluster=self.cluster)
+            if not ctrl.finalize(nc, launch_templates=self.launch_templates):
+                return False  # still referenced; caller retries
+            del self.node_classes[name]
+            return True
+        raise ValueError(f"cannot delete kind {kind!r}")
+
 
 def build_controllers(op: Operator) -> Dict[str, object]:
     """Assemble the controller set (controllers.NewControllers
     /root/reference/pkg/controllers/controllers.go:45-65 + core registration
     in cmd/controller/main.go:47-70). Interruption registers only when a
     queue is configured; pricing refresh only outside isolated networks."""
-    pools = list(op.nodepools.values())
-    provisioner = Provisioner(op.cloud_provider, op.cluster, pools)
+    provisioner = Provisioner(op.cloud_provider, op.cluster, op.nodepools)
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {
         "provisioning": provisioner,
         "termination": terminator,
         "disruption": DisruptionController(
-            op.cloud_provider, op.cluster, pools,
+            op.cloud_provider, op.cluster, op.nodepools,
             terminator=terminator, clock=op.clock,
             drift_enabled=op.options.gate("Drift")),
         "lifecycle": LifecycleController(

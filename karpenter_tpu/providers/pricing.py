@@ -55,7 +55,13 @@ class PricingProvider:
         self._spot: Dict[Tuple[str, str], float] = {}
         self._od_updated: float = 0.0
         self._spot_updated: float = 0.0
+        self._seq = 0  # bumps on refresh so catalog memoization invalidates
         self._monitor = ChangeMonitor()
+
+    @property
+    def seq_num(self) -> int:
+        with self._lock:
+            return self._seq
 
     # ---- lookups (pricing.go:118-143) ----
     def on_demand_price(self, instance_type: str) -> Optional[float]:
@@ -91,6 +97,7 @@ class PricingProvider:
         with self._lock:
             self._od = {**self._static, **prices}
             self._od_updated = self.clock()
+            self._seq += 1
         if self._monitor.has_changed("od-prices", tuple(sorted(prices.items()))):
             log.info("refreshed %d on-demand prices", len(prices))
         gauge = metrics.instance_price_estimate()
@@ -110,6 +117,7 @@ class PricingProvider:
         with self._lock:
             self._spot.update(history)
             self._spot_updated = self.clock()
+            self._seq += 1
         gauge = metrics.instance_price_estimate()
         for (itype, zone), price in history.items():
             gauge.set(price, {"instance_type": itype, "capacity_type": "spot",

@@ -293,6 +293,7 @@ class TestParityExtras:
         op1 = _seed_cloud(Operator(Options(), catalog=generate_catalog(5),
                                    clock=lambda: clock[0]))
         pool = NodePool(template=NodePoolTemplate(
+            labels={"team": "ml"},
             taints=[Taint("dedicated", "NoSchedule", "ml")]))
         op1.nodepools["default"] = pool
         mgr1 = ControllerManager(op1, build_controllers(op1),
@@ -313,5 +314,73 @@ class TestParityExtras:
         node2 = next(iter(op2.cluster.nodes.values()))
         assert node2.labels.get(wk.INSTANCE_TYPE) == node1.instance_type
         assert node2.labels.get(wk.ZONE) == node1.zone
+        assert node2.labels.get("team") == "ml"  # custom label survived
         assert any(t.key == "dedicated" and t.value == "ml"
                    for t in node2.taints)
+
+
+class TestApply:
+    def _op(self):
+        clock = [1000.0]
+        return _seed_cloud(Operator(Options(), catalog=generate_catalog(10),
+                                    clock=lambda: clock[0])), clock
+
+    def test_apply_nodepool_reaches_running_controllers(self):
+        from karpenter_tpu.api.serialize import nodepool_to_manifest
+        from karpenter_tpu.api.objects import NodePool, NodePoolTemplate
+        op, clock = self._op()
+        mgr = ControllerManager(op, build_controllers(op),
+                                clock=lambda: clock[0])
+        pool = NodePool(name="team-b",
+                        template=NodePoolTemplate(labels={"team": "b"}))
+        op.apply(nodepool_to_manifest(pool))
+        # the pool applied AFTER controller construction must be solvable
+        op.cluster.add_pods([Pod(requests=ResourceList(
+            {CPU: 500, MEMORY: 512 * 2**20}),
+            node_selector={"team": "b"})])
+        mgr.tick()
+        clock[0] += 1.1
+        res = mgr.tick()
+        assert res["provisioning"].scheduled == 1
+        node = next(iter(op.cluster.nodes.values()))
+        assert node.nodepool == "team-b"
+
+    def test_apply_validates(self):
+        from karpenter_tpu.controllers.nodeclass import ValidationError
+        op, _ = self._op()
+        bad = {"apiVersion": "karpenter.tpu/v1beta1", "kind": "NodePool",
+               "metadata": {"name": "x"}, "spec": {"weight": 9000,
+                                                   "template": {}}}
+        with pytest.raises(ValidationError):
+            op.apply(bad)
+        assert "x" not in op.nodepools
+
+    def test_apply_converts_legacy(self):
+        op, _ = self._op()
+        legacy = {"apiVersion": "karpenter.tpu/v1alpha5", "kind": "Provisioner",
+                  "metadata": {"name": "legacy-pool"},
+                  "spec": {"ttlSecondsAfterEmpty": 30}}
+        pool = op.apply(legacy)
+        assert op.nodepools["legacy-pool"] is pool
+        assert pool.disruption.consolidation_policy == "WhenEmpty"
+
+    def test_apply_nodeclass_and_blocked_delete(self):
+        from karpenter_tpu.api.objects import NodeClaim
+        op, _ = self._op()
+        nc = op.apply({"apiVersion": "karpenter.tpu/v1beta1",
+                       "kind": "NodeClass", "metadata": {"name": "gpu"},
+                       "spec": {"imageFamily": "standard", "role": "r"}})
+        assert op.node_classes["gpu"] is nc
+        claim = NodeClaim(nodepool="p", node_class_ref="gpu")
+        op.cluster.nodeclaims[claim.name] = claim
+        assert not op.delete("NodeClass", "gpu")   # blocked by the claim
+        claim.terminating = True
+        assert op.delete("NodeClass", "gpu")
+        assert "gpu" not in op.node_classes
+
+    def test_crd_schema_files_match_generator(self):
+        import json
+        from karpenter_tpu.api.serialize import crd_schemas
+        for kind, schema in crd_schemas().items():
+            with open(f"deploy/crds/{kind.lower()}.schema.json") as f:
+                assert json.load(f) == schema

@@ -193,3 +193,57 @@ class TestPricingProvider:
         assert not ctrl.reconcile()  # not due yet
         clock[0] += 101
         assert ctrl.reconcile()
+
+
+class TestPricingCatalogWiring:
+    def test_live_prices_flow_into_offerings(self):
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.cloud.provider import CloudProvider
+        catalog = generate_catalog(5)
+        api = FakePricingAPI()
+        cloud = FakeCloud()
+        pricing = PricingProvider(pricing_api=api, cloud=cloud,
+                                  static_fallback=static_price_table(catalog))
+        provider = CloudProvider(cloud, catalog, pricing=pricing)
+        name = catalog[0].name
+        static_price = [o.price for it in provider.get_instance_types()
+                        if it.name == name
+                        for o in it.offerings if o.capacity_type == "on-demand"][0]
+        # before any refresh: the catalog's own prices are served
+        assert static_price == [o.price for o in catalog[0].offerings
+                                if o.capacity_type == "on-demand"][0]
+        # refresh with a changed price: catalog memo invalidates on seq bump
+        api.on_demand = {name: 99.0}
+        assert pricing.update_on_demand_pricing()
+        fresh = [o.price for it in provider.get_instance_types()
+                 if it.name == name
+                 for o in it.offerings if o.capacity_type == "on-demand"][0]
+        assert fresh == 99.0
+
+    def test_spot_history_flows_per_zone(self):
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.cloud.provider import CloudProvider
+        catalog = generate_catalog(5)
+        cloud = FakeCloud()
+        name = catalog[0].name
+        zone = catalog[0].offerings[0].zone
+        pricing = PricingProvider(pricing_api=FakePricingAPI(), cloud=cloud,
+                                  static_fallback=static_price_table(catalog))
+        provider = CloudProvider(cloud, catalog, pricing=pricing)
+        cloud.spot_prices = {(name, zone): 0.011}
+        assert pricing.update_spot_pricing()
+        spot = [o.price for it in provider.get_instance_types()
+                if it.name == name
+                for o in it.offerings
+                if o.capacity_type == "spot" and o.zone == zone]
+        assert spot and spot[0] == 0.011
+
+    def test_instance_type_gauges_set(self):
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.cloud.provider import CloudProvider
+        from karpenter_tpu.utils import metrics
+        catalog = generate_catalog(3)
+        provider = CloudProvider(FakeCloud(), catalog)
+        provider.get_instance_types()
+        g = metrics.instance_type_cpu()
+        assert g.value({"instance_type": catalog[0].name}) > 0
