@@ -246,6 +246,7 @@ class NodeClaim:
     requests: ResourceList = field(default_factory=ResourceList)
     taints: List[Taint] = field(default_factory=list)
     node_class_ref: str = "default"
+    node_class_hash: str = ""  # nodeclass static hash at launch (drift input)
     labels: Dict[str, str] = field(default_factory=dict)
     name: str = field(default_factory=lambda: _uid("nodeclaim"))
     # lifecycle (launch → registered → initialized), §2.2 NodeClaim lifecycle

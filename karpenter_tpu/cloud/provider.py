@@ -59,34 +59,38 @@ class InstanceTypesProvider:
     _memo: Tuple[tuple, List[InstanceType]] = field(default=None, repr=False)
 
     def _offering_price(self, it: InstanceType, o: Offering,
-                        use_live: bool) -> float:
-        # until the first live refresh the catalog's own (zone- and
+                        live_od: bool, live_spot: bool) -> float:
+        # until a table's first live refresh the catalog's own (zone- and
         # capacity-type-differentiated) prices are authoritative — the
-        # pricing provider's static fallback is a lossy per-type min
-        if not use_live:
-            return o.price
+        # pricing provider's fallbacks are lossy (per-type min OD, synthetic
+        # spot discount); liveness is decided per table
         if o.capacity_type == wk.CAPACITY_TYPE_SPOT:
+            if not live_spot:
+                return o.price
             p = self.pricing.spot_price(it.name, o.zone)
         else:
+            if not live_od:
+                return o.price
             p = self.pricing.on_demand_price(it.name)
         return o.price if p is None else p
 
     def list(self) -> List[InstanceType]:
-        # the pricing seq is read ONCE per rebuild: it keys the memo and
-        # decides whether live prices apply, so a refresh landing mid-rebuild
+        # the pricing seqs are read ONCE per rebuild: they key the memo and
+        # decide which tables apply live, so a refresh landing mid-rebuild
         # just invalidates the next lookup instead of mixing tables
-        price_seq = 0 if self.pricing is None else self.pricing.seq_num
-        key = (self.unavailable.seq_num, price_seq)
+        od_seq, spot_seq = (0, 0) if self.pricing is None \
+            else self.pricing.seq_num
+        key = (self.unavailable.seq_num, od_seq, spot_seq)
         if self._memo is not None and self._memo[0] == key:
             return self._memo[1]
-        use_live = price_seq > 0
+        live_od, live_spot = od_seq > 0, spot_seq > 0
         out = []
         cpu_gauge = metrics.instance_type_cpu()
         mem_gauge = metrics.instance_type_memory()
         for it in self.base_catalog:
             offerings = [
                 Offering(o.zone, o.capacity_type,
-                         self._offering_price(it, o, use_live),
+                         self._offering_price(it, o, live_od, live_spot),
                          available=o.available and not self.unavailable.is_unavailable(
                              o.capacity_type, it.name, o.zone))
                 for o in it.offerings
@@ -287,6 +291,15 @@ class CloudProvider:
                   if "kubernetes.io" not in k and not k.startswith("karpenter")}
         if custom:
             tags["karpenter.sh/labels"] = json.dumps(custom, sort_keys=True)
+        # stamp the nodeclass spec hash the node was launched from — the
+        # static-drift input (utils/nodeclass.HashAnnotation via
+        # cloudprovider.go:116)
+        if nodeclass is not None:
+            if not nodeclass.hash_annotation:
+                from ..controllers.nodeclass import static_hash
+                nodeclass.hash_annotation = static_hash(nodeclass)
+            claim.node_class_hash = nodeclass.hash_annotation
+            tags["karpenter.sh/nodeclass-hash"] = nodeclass.hash_annotation
         result = self.cloud.create_fleet(overrides, count=1, tags=tags)
         # settle the in-flight IP predictions against where the launch landed
         # (subnet.go UpdateInflightIPs:149)
@@ -382,12 +395,14 @@ class CloudProvider:
         if taints_json:
             claim.taints = [Taint(d["key"], d["effect"], d.get("value", ""))
                             for d in json.loads(taints_json)]
+        claim.node_class_hash = inst.tags.get("karpenter.sh/nodeclass-hash", "")
         return claim
 
     def is_drifted(self, claim: NodeClaim, nodepool: Optional[NodePool] = None) -> Optional[str]:
-        """Static drift detection analog
-        (/root/reference/pkg/cloudprovider/drift.go:42-67): the claim's
-        instance type must still exist in the catalog and satisfy the pool."""
+        """Drift detection analog
+        (/root/reference/pkg/cloudprovider/drift.go:42-67): static hash of
+        the nodeclass spec the node was launched from vs its current hash
+        (the reference's primary mechanism), plus catalog/pool/zone checks."""
         it = next((t for t in self.instance_types.base_catalog
                    if t.name == claim.instance_type), None)
         if it is None:
@@ -397,8 +412,14 @@ class CloudProvider:
                     it.requirements, allow_undefined=[wk.NODEPOOL]):
                 return "NodePoolDrifted"
         nc = self.node_classes.get(claim.node_class_ref)
-        if nc is not None and nc.status_zones and claim.zone not in nc.status_zones:
-            return "ZoneDrifted"
+        if nc is not None:
+            if claim.node_class_hash:
+                from ..controllers.nodeclass import static_hash
+                current = nc.hash_annotation or static_hash(nc)
+                if claim.node_class_hash != current:
+                    return "NodeClassHashDrifted"
+            if nc.status_zones and claim.zone not in nc.status_zones:
+                return "ZoneDrifted"
         return None
 
     def liveness_probe(self) -> bool:

@@ -55,13 +55,17 @@ class PricingProvider:
         self._spot: Dict[Tuple[str, str], float] = {}
         self._od_updated: float = 0.0
         self._spot_updated: float = 0.0
-        self._seq = 0  # bumps on refresh so catalog memoization invalidates
+        # per-table refresh counters: liveness is PER TABLE, so an OD-only
+        # refresh never degrades catalog spot prices to the synthetic
+        # discount (and vice versa); the pair keys catalog memoization
+        self._od_seq = 0
+        self._spot_seq = 0
         self._monitor = ChangeMonitor()
 
     @property
-    def seq_num(self) -> int:
+    def seq_num(self) -> Tuple[int, int]:
         with self._lock:
-            return self._seq
+            return (self._od_seq, self._spot_seq)
 
     # ---- lookups (pricing.go:118-143) ----
     def on_demand_price(self, instance_type: str) -> Optional[float]:
@@ -97,7 +101,7 @@ class PricingProvider:
         with self._lock:
             self._od = {**self._static, **prices}
             self._od_updated = self.clock()
-            self._seq += 1
+            self._od_seq += 1
         if self._monitor.has_changed("od-prices", tuple(sorted(prices.items()))):
             log.info("refreshed %d on-demand prices", len(prices))
         gauge = metrics.instance_price_estimate()
@@ -114,10 +118,12 @@ class PricingProvider:
         except CloudError as e:
             log.warning("spot price refresh failed, keeping stale table: %s", e)
             return False
+        if not history:
+            return False  # no data is not a refresh (matches the OD guard)
         with self._lock:
             self._spot.update(history)
             self._spot_updated = self.clock()
-            self._seq += 1
+            self._spot_seq += 1
         gauge = metrics.instance_price_estimate()
         for (itype, zone), price in history.items():
             gauge.set(price, {"instance_type": itype, "capacity_type": "spot",

@@ -436,3 +436,34 @@ def test_candidates_ranked_by_disruption_cost():
     cands = ctrl.candidates()
     assert len(cands) == 2
     assert cands[0].name == light.node_name   # fewer/lower-priority pods first
+
+
+class TestStaticHashDrift:
+    def test_nodeclass_spec_change_drifts_launched_nodes(self):
+        from karpenter_tpu.api.objects import NodeClaim, NodeClass
+        from karpenter_tpu.cloud import CloudProvider, FakeCloud
+        from karpenter_tpu.controllers.nodeclass import static_hash
+        from helpers import small_catalog
+        nc = NodeClass(user_data="v1")
+        provider = CloudProvider(FakeCloud(), small_catalog(),
+                                 node_classes={"default": nc})
+        claim = provider.create(NodeClaim(nodepool="p"))
+        assert claim.node_class_hash == static_hash(nc)
+        assert provider.is_drifted(claim) is None
+        # spec change: hash annotation refreshes (nodeclass controller does
+        # this on reconcile) and the old node drifts
+        nc.user_data = "v2"
+        nc.hash_annotation = static_hash(nc)
+        assert provider.is_drifted(claim) == "NodeClassHashDrifted"
+
+    def test_hash_survives_hydration(self):
+        from karpenter_tpu.api.objects import NodeClaim, NodeClass
+        from karpenter_tpu.cloud import CloudProvider, FakeCloud
+        from helpers import small_catalog
+        cloud = FakeCloud()
+        nc = NodeClass(user_data="v1")
+        p1 = CloudProvider(cloud, small_catalog(), node_classes={"default": nc})
+        claim = p1.create(NodeClaim(nodepool="p"))
+        p2 = CloudProvider(cloud, small_catalog(), node_classes={"default": nc})
+        rebuilt = p2.list()[0]
+        assert rebuilt.node_class_hash == claim.node_class_hash

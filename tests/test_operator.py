@@ -380,7 +380,9 @@ class TestApply:
 
     def test_crd_schema_files_match_generator(self):
         import json
+        import pathlib
         from karpenter_tpu.api.serialize import crd_schemas
+        crds = pathlib.Path(__file__).resolve().parents[1] / "deploy" / "crds"
         for kind, schema in crd_schemas().items():
-            with open(f"deploy/crds/{kind.lower()}.schema.json") as f:
+            with open(crds / f"{kind.lower()}.schema.json") as f:
                 assert json.load(f) == schema
