@@ -299,6 +299,7 @@ class CloudProvider:
                 from ..controllers.nodeclass import static_hash
                 nodeclass.hash_annotation = static_hash(nodeclass)
             claim.node_class_hash = nodeclass.hash_annotation
+            tags["karpenter.sh/nodeclass"] = claim.node_class_ref
             tags["karpenter.sh/nodeclass-hash"] = nodeclass.hash_annotation
         result = self.cloud.create_fleet(overrides, count=1, tags=tags)
         # settle the in-flight IP predictions against where the launch landed
@@ -395,6 +396,11 @@ class CloudProvider:
         if taints_json:
             claim.taints = [Taint(d["key"], d["effect"], d.get("value", ""))
                             for d in json.loads(taints_json)]
+        # the ref must restore WITH the hash, else a restarted operator
+        # compares a non-default nodeclass's launch hash against "default"
+        # and churn-replaces every healthy recovered node as drifted
+        if inst.tags.get("karpenter.sh/nodeclass"):
+            claim.node_class_ref = inst.tags["karpenter.sh/nodeclass"]
         claim.node_class_hash = inst.tags.get("karpenter.sh/nodeclass-hash", "")
         return claim
 

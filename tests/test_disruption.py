@@ -467,3 +467,19 @@ class TestStaticHashDrift:
         p2 = CloudProvider(cloud, small_catalog(), node_classes={"default": nc})
         rebuilt = p2.list()[0]
         assert rebuilt.node_class_hash == claim.node_class_hash
+
+    def test_non_default_nodeclass_ref_survives_hydration(self):
+        from karpenter_tpu.api.objects import NodeClaim, NodeClass
+        from karpenter_tpu.cloud import CloudProvider, FakeCloud
+        from helpers import small_catalog
+        cloud = FakeCloud()
+        classes = {"default": NodeClass(), "gpu": NodeClass(name="gpu",
+                                                            user_data="gpu-init")}
+        p1 = CloudProvider(cloud, small_catalog(), node_classes=classes)
+        claim = p1.create(NodeClaim(nodepool="p", node_class_ref="gpu"))
+        assert p1.is_drifted(claim) is None
+        # operator restart: fresh provider over the same cloud
+        p2 = CloudProvider(cloud, small_catalog(), node_classes=classes)
+        rebuilt = p2.list()[0]
+        assert rebuilt.node_class_ref == "gpu"
+        assert p2.is_drifted(rebuilt) is None  # healthy node is NOT drifted
