@@ -294,12 +294,15 @@ class CloudProvider:
         # stamp the nodeclass spec hash the node was launched from — the
         # static-drift input (utils/nodeclass.HashAnnotation via
         # cloudprovider.go:116)
+        # the ref tag is durable identity — written even when the nodeclass
+        # doesn't currently resolve (bare launch path), so hydration never
+        # falls back to "default" and mis-attributes the node
+        tags["karpenter.sh/nodeclass"] = claim.node_class_ref
         if nodeclass is not None:
             if not nodeclass.hash_annotation:
                 from ..controllers.nodeclass import static_hash
                 nodeclass.hash_annotation = static_hash(nodeclass)
             claim.node_class_hash = nodeclass.hash_annotation
-            tags["karpenter.sh/nodeclass"] = claim.node_class_ref
             tags["karpenter.sh/nodeclass-hash"] = nodeclass.hash_annotation
         result = self.cloud.create_fleet(overrides, count=1, tags=tags)
         # settle the in-flight IP predictions against where the launch landed

@@ -73,7 +73,9 @@ def test_converges_under_sustained_chaos(stack):
         except CloudError:
             pass  # injected one-shot API error surfaced; loop continues
 
-    # quiesce: no more chaos, let the loop settle
+    # quiesce: no more chaos, let the loop settle (clear any armed one-shot
+    # error a no-op tick never consumed)
+    op.cloud.next_error = None
     for _ in range(30):
         clock[0] += 5.0
         mgr.tick()
