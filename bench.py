@@ -117,6 +117,45 @@ def run_config(name, pods, n_types, pools=None, iters=5):
     return p50, t_tensorize
 
 
+def run_consolidation_replay(n_nodes=500, n_types=200, iters=3):
+    """BASELINE config 4: 500-node consolidation replay — one batched
+    candidate evaluation over a live cluster (the reference replays the
+    scheduler once per candidate; here all candidates are one simulate)."""
+    import numpy as np
+    from karpenter_tpu.api.objects import NodePool, Pod
+    from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.cloud import CloudProvider, FakeCloud
+    from karpenter_tpu.controllers import Provisioner
+    from karpenter_tpu.controllers.disruption import DisruptionController
+    from karpenter_tpu.state import Cluster
+
+    rng = np.random.default_rng(3)
+    catalog = generate_catalog(n_types)
+    provider = CloudProvider(FakeCloud(), catalog)
+    cluster = Cluster()
+    pools = [NodePool()]
+    prov = Provisioner(provider, cluster, pools)
+    # ~60% utilization so plenty of consolidation candidates exist
+    cluster.add_pods([Pod(requests=ResourceList(
+        {CPU: int(rng.integers(1500, 2600)), MEMORY: int(rng.integers(2, 5)) * 2**30}))
+        for _ in range(n_nodes)])
+    prov.provision()
+    ctrl = DisruptionController(provider, cluster, pools,
+                                clock=lambda: time.time() + 10_000)
+    cands = ctrl.candidates()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        ctrl.simulate(cands[:1], allow_new=True,
+                      max_total_price=cands[0].price if cands else None)
+        times.append((time.perf_counter() - t0) * 1000)
+    p50 = float(np.median(times))
+    log(f"[consolidation-replay] nodes={len(cluster.nodes)} "
+        f"candidates={len(cands)} simulate_p50={p50:.1f}ms")
+    return p50
+
+
 def main():
     import jax
     log("devices:", jax.devices())
@@ -128,6 +167,8 @@ def main():
     run_config("10k-mixed", build_pods(100, 10_000, rng, zone_frac=0.3), 200, iters=3)
     # config 3: 5k GPU pods
     run_config("5k-gpu", build_pods(40, 5_000, rng, gpu_frac=1.0), 600, iters=3)
+    # config 4: 500-node consolidation replay
+    run_consolidation_replay()
     # config 5 (headline): 50k burst, 600 types, constraints + spot/od pricing
     headline_pods = build_pods(200, 50_000, rng, gpu_frac=0.05, zone_frac=0.2,
                                taint_frac=0.1)
