@@ -193,3 +193,68 @@ def test_node_labels_populated():
     assert node.labels[wk.NODEPOOL] == "default"
     assert node.labels[wk.ZONE] in ("zone-a", "zone-b")
     assert wk.HOSTNAME in node.labels
+
+
+class TestSolverRouting:
+    """The provisioning hot path runs on the flagship class-granular kernel
+    (the same call bench.py times); tiny batches use the pod-granular
+    solve's native fast path."""
+
+    def _mixed_pods(self, n):
+        rng = np.random.default_rng(7)
+        pods = []
+        for i in range(n):
+            pods.append(cpu_pod(cpu_m=int(rng.choice([100, 250, 500, 1000, 2000])),
+                                mem_mib=int(rng.choice([128, 256, 512, 1024, 2048]))))
+        return pods
+
+    def test_auto_picks_classpack_above_cutover(self):
+        from karpenter_tpu.ops.classpack import solve_classpack
+        from karpenter_tpu.ops.ffd import NATIVE_CUTOVER_ROWS, solve_ffd
+        cloud, provider, cluster, prov = env()
+        cluster.add_pods(self._mixed_pods(NATIVE_CUTOVER_ROWS + 50))
+        pods = cluster.pending_pods()
+        from karpenter_tpu.ops.tensorize import tensorize
+        problem = tensorize(pods, provider.get_instance_types(),
+                            [NodePool()])
+        assert prov._pick_solver(problem) is solve_classpack
+        # and the small case stays on the pod-granular path
+        small = tensorize(pods[:4], provider.get_instance_types(), [NodePool()])
+        assert prov._pick_solver(small) is solve_ffd
+
+    def test_classpack_provision_end_to_end(self):
+        """A >cutover batch provisions entirely through solve_classpack:
+        everything schedules, nodes are packed, claims launch on the fake
+        cloud, and a second round binds to the capacity just created."""
+        cloud, provider, cluster, prov = env()
+        pods = self._mixed_pods(300)
+        cluster.add_pods(pods)
+        res = prov.provision()
+        assert res.scheduled == 300
+        assert not res.unschedulable
+        assert len(res.launched) < 300  # actually packed
+        assert len(cloud.running()) == len(res.launched)
+        # second round: small pods bind to the freshly-launched capacity
+        cluster.add_pods([cpu_pod(cpu_m=50, mem_mib=64) for _ in range(5)])
+        r2 = prov.provision()
+        assert r2.scheduled == 5
+
+    def test_classpack_matches_ffd_cost_envelope(self):
+        """Forced-classpack and forced-ffd provisioners schedule the same
+        workload at comparable cost (class-granular packing may differ
+        slightly in node mix but must not be wildly worse)."""
+        pods = self._mixed_pods(300)
+        costs = {}
+        for solver in ("classpack", "ffd"):
+            cloud = FakeCloud()
+            provider = CloudProvider(cloud, small_catalog())
+            cluster = Cluster()
+            prov = Provisioner(provider, cluster, [NodePool()], solver=solver)
+            cluster.add_pods([Pod(requests=p.requests) for p in pods])
+            res = prov.provision()
+            assert res.scheduled == 300, solver
+            by_name = {it.name: it for it in provider.get_instance_types()}
+            costs[solver] = sum(
+                by_name[c.instance_type].cheapest_offering().price
+                for c in res.launched)
+        assert costs["classpack"] <= costs["ffd"] * 1.10 + 1e-6
