@@ -81,6 +81,7 @@ class _Bucket:
         self.opened: float = 0.0
         self.last_add: float = 0.0
         self.closed = False
+        self.closed_event = threading.Event()  # wakes the flusher on early close
         self.done = threading.Condition()
 
 
@@ -123,12 +124,17 @@ class Batcher(Generic[Req, Res]):
         # caller holds self._lock
         if not bucket.closed:
             bucket.closed = True
+            bucket.closed_event.set()
             if self._open.get(key) is bucket:
                 del self._open[key]
 
     def _flusher(self, key: Hashable, bucket: _Bucket) -> None:
         """Window clock: wake at the earlier of idle/max deadline, then run
-        the batch (batcher.go waitForIdle:161-182 + runCalls:184)."""
+        the batch (batcher.go waitForIdle:161-182 + runCalls:184).
+
+        Sleeps the FULL computed wait: a new add() can only push the idle
+        deadline later, never earlier, so no poll is needed — the only early
+        wake is the max_items close, signaled via closed_event."""
         while True:
             with self._lock:
                 if bucket.closed:
@@ -141,7 +147,7 @@ class Batcher(Generic[Req, Res]):
                     self._close(key, bucket)
                     break
                 wait = deadline - now
-            time.sleep(min(wait, 0.005))
+            bucket.closed_event.wait(timeout=wait)
         self._run(bucket)
 
     def _run(self, bucket: _Bucket) -> None:

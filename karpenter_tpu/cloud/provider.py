@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import copy
 import json
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -28,6 +29,8 @@ from ..catalog.instancetype import InstanceType, Offering
 from ..utils import metrics
 from .cache import UnavailableOfferings
 from .fake import CloudError, FakeCloud, FleetOverride, FleetResult, ICE_CODE
+
+log = logging.getLogger("karpenter_tpu.cloud.provider")
 
 # Launch action-space cap (/root/reference/pkg/providers/instance/instance.go:56-57).
 MAX_INSTANCE_TYPES = 60
@@ -273,11 +276,16 @@ class CloudProvider:
         if not overrides:
             raise InsufficientCapacityError(
                 f"no available offerings for claim {claim.name}")
+        # fleet tags are POOL-scoped only: the batcher hashes them, and
+        # per-claim-unique values would put every single-capacity request in
+        # its own bucket, making merging dead code. Claim identity goes on
+        # post-launch via create_tags, mirroring the reference (getTags uses
+        # only pool-scoped values; identity lands via the tagging flow,
+        # /root/reference/pkg/providers/instance/instance.go:255-275 +
+        # /root/reference/pkg/controllers/nodeclaim/tagging/controller.go).
         tags = {
             "karpenter.sh/cluster": self.cluster_name,
             "karpenter.sh/nodepool": claim.nodepool,
-            "karpenter.sh/nodeclaim": claim.name,
-            "Name": f"{claim.nodepool}/{claim.name}",
         }
         if claim.taints:
             # taints ride along as a tag so restart hydration can restore
@@ -322,6 +330,17 @@ class CloudProvider:
             raise InsufficientCapacityError(
                 f"all {len(overrides)} offerings ICE'd for claim {claim.name}")
         inst = result.instances[0]
+        # claim identity (unique per launch) is tagged after the fleet call
+        # so same-shape requests keep merging in the batcher
+        try:
+            self.cloud.create_tags(inst.id, {
+                "karpenter.sh/nodeclaim": claim.name,
+                "Name": f"{claim.nodepool}/{claim.name}",
+            })
+        except CloudError as e:
+            # instance launched; identity tag retries via TaggingController
+            log.warning("post-launch identity tagging failed for %s: %s",
+                        inst.id, e)
         claim.provider_id = inst.id
         claim.instance_type = inst.instance_type
         claim.zone = inst.zone

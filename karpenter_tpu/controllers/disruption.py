@@ -55,6 +55,10 @@ DISRUPTION_TAINT = Taint(wk.DISRUPTION_TAINT_KEY, NO_SCHEDULE, "disrupting")
 # Tunables (/root/reference/designs/consolidation.md:61-67,
 # /root/reference/designs/deprovisioning.md:27-33).
 DEFAULT_STABILIZATION_S = 5 * 60.0   # min node lifetime before disruption
+# spot→spot replacement keeps this many cheaper launch alternatives so the
+# new node retains fleet flexibility (reference consolidation docs: ≥15
+# cheaper offerings required for spot-to-spot consolidation)
+SPOT_TO_SPOT_MIN_ALTERNATIVES = 15
 
 
 @dataclass
@@ -123,7 +127,8 @@ class DisruptionController:
                  stabilization_s: float = DEFAULT_STABILIZATION_S,
                  drift_enabled: bool = True,
                  max_candidates: int = 64,
-                 terminator: Optional["TerminationController"] = None):
+                 terminator: Optional["TerminationController"] = None,
+                 spot_min_flexibility: int = SPOT_TO_SPOT_MIN_ALTERNATIVES):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
@@ -132,6 +137,7 @@ class DisruptionController:
         self.stabilization_s = stabilization_s
         self.drift_enabled = drift_enabled
         self.max_candidates = max_candidates
+        self.spot_min_flexibility = spot_min_flexibility
         self._empty_since: Dict[str, float] = {}  # node → first seen empty
 
     # ------------------------------------------------------------------
@@ -376,13 +382,26 @@ class DisruptionController:
                               problem=problem, surviving_nodes=survivors)
             if result.total_price >= c.price:
                 continue
-            # spot→spot replacement needs flexibility: require the cheaper
-            # node to have alternatives (reference requires ≥15 cheaper
-            # offerings for spot; we require >1 as the fake catalog is small)
+            # spot→spot replacement needs flexibility (the reference's ≥15
+            # cheaper-offerings floor): count only SPOT alternatives strictly
+            # cheaper than the replaced node — on-demand options don't keep a
+            # spot launch flexible. Clamped to how many cheaper spot options
+            # the pool's catalog has at all, so small catalogs still
+            # exercise the path while catalog-scale runs enforce the full 15.
+            chosen = result.nodes[0]
             if (c.node.capacity_type == wk.CAPACITY_TYPE_SPOT
-                    and result.nodes[0].option.capacity_type == wk.CAPACITY_TYPE_SPOT
-                    and len(result.nodes[0].alternatives) <= 1):
-                continue
+                    and chosen.option.capacity_type == wk.CAPACITY_TYPE_SPOT):
+                pool_spot_cheaper = sum(
+                    1 for o in problem.options
+                    if o.capacity_type == wk.CAPACITY_TYPE_SPOT
+                    and o.pool == chosen.option.pool and o.price < c.price)
+                floor = min(self.spot_min_flexibility, pool_spot_cheaper)
+                spot_alts = {a.instance_type for a in chosen.alternatives
+                             if a.capacity_type == wk.CAPACITY_TYPE_SPOT
+                             and a.price < c.price}
+                spot_alts.add(chosen.option.instance_type)
+                if len(spot_alts) < floor:
+                    continue
             return Action(kind="replace", reason="consolidation",
                           candidates=[c], simulation=result, problem=problem,
                           surviving_nodes=survivors)

@@ -92,6 +92,9 @@ class TaggingController:
 
     def reconcile(self) -> List[str]:
         tagged = []
+        claim_by_pid = {c.provider_id: c
+                        for c in self.cluster.nodeclaims.values()
+                        if c.provider_id}
         for node in self.cluster.nodes.values():
             if not node.provider_id:
                 continue
@@ -99,8 +102,17 @@ class TaggingController:
                 inst = self.provider.cloud.get_instance(node.provider_id)
             except Exception:  # noqa: BLE001 — instance gone; GC's problem
                 continue
-            if inst.tags.get(self.NODE_NAME_TAG) != node.name:
-                self.provider.cloud.create_tags(
-                    node.provider_id, {self.NODE_NAME_TAG: node.name})
+            want = {self.NODE_NAME_TAG: node.name}
+            # claim identity rides post-launch (fleet tags are pool-scoped
+            # so the batcher can merge); re-assert it here in case the
+            # launch-path create_tags failed
+            claim = claim_by_pid.get(node.provider_id)
+            if claim is not None:
+                want["karpenter.sh/nodeclaim"] = claim.name
+                want["Name"] = f"{claim.nodepool}/{claim.name}"
+            missing = {k: v for k, v in want.items()
+                       if inst.tags.get(k) != v}
+            if missing:
+                self.provider.cloud.create_tags(node.provider_id, missing)
                 tagged.append(node.provider_id)
         return tagged

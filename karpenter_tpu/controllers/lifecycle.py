@@ -112,21 +112,37 @@ class LifecycleController:
         self.recorder.publish(Event("NodeClaim", claim.name, "Registered",
                                     f"node {node.name} joined"))
 
+    # boot-time taints whose owners (kubelet / cloud-controller) remove them
+    # as part of normal startup; the substrate simulation may clear these.
+    # Condition taints like node.kubernetes.io/unreachable are NOT listed:
+    # auto-clearing them would mask genuine node conditions — initialization
+    # simply waits for their owners instead (core initialization semantics).
+    EPHEMERAL_STARTUP_TAINTS = frozenset({
+        "node.kubernetes.io/not-ready",
+        "node.cloudprovider.kubernetes.io/uninitialized",
+    })
+
     def _try_initialize(self, node: Node, claim: NodeClaim,
                         out: LifecycleResult) -> None:
         """Initialized == startup taints cleared ∧ capacity reported
         (core initialization semantics)."""
         pool = self.nodepools.get(claim.nodepool)
-        startup_keys = {t.key for t in pool.template.startup_taints} \
+        clearable = {t.key for t in pool.template.startup_taints} \
             if pool is not None else set()
-        startup_keys |= {t.key for t in node.taints
-                         if t.key.startswith("node.kubernetes.io/")}
-        present = [t for t in node.taints if t.key in startup_keys]
+        clearable |= {t.key for t in node.taints
+                      if t.key in self.EPHEMERAL_STARTUP_TAINTS}
+        present = [t for t in node.taints if t.key in clearable]
         if present:
-            # the (fake) kubelet/daemons clear startup taints on this pass;
-            # initialization completes on the next one (taint clearance and
-            # readiness are separate observations in the reference too)
-            node.taints = [t for t in node.taints if t.key not in startup_keys]
+            # the (fake) kubelet/daemons clear declared startup + known
+            # ephemeral taints on this pass; initialization completes on the
+            # next one (taint clearance and readiness are separate
+            # observations in the reference too)
+            node.taints = [t for t in node.taints if t.key not in clearable]
+            return
+        # any remaining node.kubernetes.io/* taint is a live condition
+        # (unreachable, disk-pressure…) owned by the node controller — wait,
+        # never clear
+        if any(t.key.startswith("node.kubernetes.io/") for t in node.taints):
             return
         if not node.allocatable:
             return  # capacity not reported yet

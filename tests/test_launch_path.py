@@ -102,3 +102,46 @@ def test_inflight_refunded_when_no_image_covers(stack):
         provider.create(NodeClaim(nodepool="default"))
     assert subnets.inflight("subnet-a") == 0
     assert subnets.inflight("subnet-b") == 0
+
+
+def test_fleet_tags_are_pool_scoped_so_batching_merges():
+    """Fleet tags carry no per-claim identity — identical claims from the
+    same pool hash to the same batch bucket and merge into ONE create_fleet
+    call; identity tags land post-launch via create_tags (reference tags
+    per-pool at launch, identity via the tagging flow)."""
+    import threading
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.cloud.batcher import BatchedCloud
+
+    cloud = FakeCloud()
+    batched = BatchedCloud(cloud, idle=0.05)
+    provider = CloudProvider(batched, generate_catalog(12), cluster_name="kc")
+
+    def mk(i):
+        return NodeClaim(name=f"claim-{i}", nodepool="default")
+
+    claims = [mk(i) for i in range(4)]
+    out, errs = [None] * 4, []
+
+    def create(i):
+        try:
+            out[i] = provider.create(claims[i])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=create, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert cloud.calls.get("create_fleet", 0) == 1, \
+        f"expected one merged fleet call, got {cloud.calls}"
+    ids = {c.provider_id for c in out}
+    assert len(ids) == 4  # each caller got its own instance
+    # every instance carries its own claim identity, applied post-launch
+    for c in out:
+        inst = cloud.get_instance(c.provider_id)
+        assert inst.tags["karpenter.sh/nodeclaim"] == c.name
+        assert inst.tags["Name"] == f"default/{c.name}"
+        assert inst.tags["karpenter.sh/nodepool"] == "default"

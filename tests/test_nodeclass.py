@@ -190,3 +190,54 @@ class TestLifecycle:
         cloud.get_instance(claim.provider_id).state = "terminated"
         res = lc.reconcile()
         assert res.liveness_terminated == [claim.name]
+
+
+class TestLifecycleConditionTaints:
+    """Initialization waits for condition taints instead of clearing them:
+    only declared startup taints + known ephemeral boot taints are cleared
+    by the substrate simulation (ADVICE r1: auto-clearing the whole
+    node.kubernetes.io/ prefix would mask conditions like unreachable)."""
+
+    def _registered(self):
+        clock = [1000.0]
+        cloud = FakeCloud(clock=lambda: clock[0])
+        provider = CloudProvider(cloud, generate_catalog(8),
+                                 clock=lambda: clock[0])
+        cluster = Cluster(clock=lambda: clock[0])
+        pool = NodePool(template=NodePoolTemplate(
+            startup_taints=[Taint("init.example.com/agent", "NoSchedule")]))
+        lc = LifecycleController(provider, cluster, nodepools={"default": pool},
+                                 join_delay=0.0, clock=lambda: clock[0])
+        claim = provider.create(NodeClaim(
+            nodepool="default", taints=list(pool.template.startup_taints)))
+        lc.track(claim)
+        # registers immediately (join_delay=0); the declared startup taint
+        # is cleared on this pass, leaving the claim NOT yet initialized
+        lc.reconcile()
+        node = next(iter(cluster.nodes.values()))
+        assert not claim.initialized
+        return lc, claim, node
+
+    def test_unreachable_blocks_and_is_not_cleared(self):
+        lc, claim, node = self._registered()
+        node.taints.append(Taint("node.kubernetes.io/unreachable", "NoExecute"))
+        for _ in range(3):
+            res = lc.reconcile()
+            assert not res.initialized
+        assert not claim.initialized
+        assert any(t.key == "node.kubernetes.io/unreachable"
+                   for t in node.taints)
+        # owner (node controller) clears it -> initialization completes
+        node.taints = [t for t in node.taints
+                       if t.key != "node.kubernetes.io/unreachable"]
+        res = lc.reconcile()
+        assert claim.initialized
+
+    def test_ephemeral_boot_taints_are_cleared(self):
+        lc, claim, node = self._registered()
+        node.taints.append(Taint("node.kubernetes.io/not-ready", "NoExecute"))
+        lc.reconcile()   # clears the known ephemeral boot taint
+        assert not any(t.key == "node.kubernetes.io/not-ready"
+                       for t in node.taints)
+        lc.reconcile()
+        assert claim.initialized
