@@ -181,10 +181,22 @@ class DisruptionController:
     def _filtered_catalog(self, max_total_price: Optional[float]) -> List[InstanceType]:
         """Launch options for replacement simulations. `max_total_price`
         strictly bounds offering price — replacement must be cheaper
-        (/root/reference/designs/consolidation.md:15-21)."""
+        (/root/reference/designs/consolidation.md:15-21).
+
+        Memoized per (catalog object, price cap): candidates are simulated
+        one per reconcile and often share prices, and returning the SAME
+        filtered list object lets the tensorize catalog-side cache hit
+        instead of rebuilding its option tables every simulation."""
         catalog = self.provider.get_instance_types()
         if max_total_price is None:
             return catalog
+        memo_cat, memo = getattr(self, "_filtcat_memo", (None, None))
+        if memo_cat is not catalog:
+            memo = {}
+            self._filtcat_memo = (catalog, memo)
+        hit = memo.get(max_total_price)
+        if hit is not None:
+            return hit
         out = []
         for it in catalog:
             offerings = [o for o in it.offerings
@@ -196,6 +208,9 @@ class DisruptionController:
                     kube_reserved=it.kube_reserved,
                     system_reserved=it.system_reserved,
                     eviction_threshold=it.eviction_threshold, info=it.info))
+        if len(memo) >= 64:  # bound growth across many distinct price caps
+            memo.clear()
+        memo[max_total_price] = out
         return out
 
     def _orig(self, p: Pod) -> Pod:

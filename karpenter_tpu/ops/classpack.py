@@ -51,19 +51,38 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
     """`node_cap` lowers hostname-granular topology constraints (hostname
     anti-affinity -> 1, hostname spread -> max_skew; see ops/constraints.py).
     Each class is placed in exactly one scan step, so clamping per-slot and
-    per-new-node occupancy inside the step enforces the cap exactly."""
+    per-new-node occupancy inside the step enforces the cap exactly.
+
+    Scan-hoisting: everything that depends only on (class × option) — pods
+    per fresh node, launchability, pool-rank preselection — is one batched
+    C×O computation BEFORE the scan (XLA fuses the R-reduction, nothing
+    C×O×R materializes); the scan carries slot FREE space rather than used,
+    so the per-step work is pure K-vector arithmetic with no O×R division
+    and no K×R gather left inside the sequential region."""
     K = max_nodes
     idx = jnp.arange(K)
 
+    # ---- per-(class × option) precompute, hoisted out of the scan ----
+    reqpos_all = requests > 0                                # C×R
+    safe_req_all = jnp.where(reqpos_all, requests, 1)
+    m_all = jnp.min(jnp.where(reqpos_all[:, None, :],
+                              alloc[None, :, :] // safe_req_all[:, None, :],
+                              _BIG), axis=-1)                # C×O pods/node
+    m_all = jnp.minimum(m_all, node_cap[:, None])            # hostname cap
+    ok_all = compat & (m_all > 0) & jnp.isfinite(price)[None, :]
+    # pool precedence: restrict to the best (lowest) weight-rank available
+    best_rank_all = jnp.min(jnp.where(ok_all, rank[None, :], _BIG), axis=1)
+    ok_all = ok_all & (rank[None, :] == best_rank_all[:, None])
+
     def step(carry, x):
-        slot_option, slot_used, n_open, n_unsched = carry
-        req, cnt, comp, cap = x
+        slot_option, slot_free, n_open, n_unsched = carry
+        req, cnt, comp, cap, m, ok = x
         opt = jnp.maximum(slot_option, 0)
         open_mask = slot_option >= 0
-        free = alloc[opt] - slot_used                       # K×R
         reqpos = req > 0
         safe_req = jnp.where(reqpos, req, 1)
-        fit = jnp.min(jnp.where(reqpos[None, :], free // safe_req[None, :], _BIG),
+        fit = jnp.min(jnp.where(reqpos[None, :],
+                                slot_free // safe_req[None, :], _BIG),
                       axis=-1)                              # pods each slot absorbs
         fit = jnp.minimum(fit, cap)                         # hostname-cap clamp
         fit = jnp.where(open_mask & comp[opt], jnp.maximum(fit, 0), 0)
@@ -74,13 +93,6 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
         # new nodes: option minimizing TOTAL cost to absorb the class tail,
         # price × ceil(remaining/m) — the tail-aware version of the
         # reference's "maximize additional pods packed" tie-break
-        m = jnp.min(jnp.where(reqpos[None, :], alloc // safe_req[None, :], _BIG),
-                    axis=-1)                                # pods per fresh node
-        m = jnp.minimum(m, cap)                             # hostname-cap clamp
-        ok = comp & (m > 0) & jnp.isfinite(price)
-        # pool precedence: restrict to the best (lowest) weight-rank available
-        best_rank = jnp.min(jnp.where(ok, rank, _BIG))
-        ok = ok & (rank == best_rank)
         m_safe = jnp.maximum(m, 1)
         nodes_needed = (jnp.maximum(remaining, 1) + m_safe - 1) // m_safe
         score = jnp.where(ok, price * nodes_needed.astype(price.dtype), jnp.inf)
@@ -97,20 +109,29 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
         pods_on = jnp.where(is_new & (idx == n_open + n_new - 1), rem_last, pods_on)
         slot_option = jnp.where(is_new, j.astype(slot_option.dtype), slot_option)
         placed = take + pods_on
-        slot_used = slot_used + placed[:, None] * req[None, :]
+        slot_free = slot_free - take[:, None] * req[None, :]
+        slot_free = jnp.where(is_new[:, None],
+                              alloc[j][None, :] - pods_on[:, None] * req[None, :],
+                              slot_free)
         n_open = n_open + n_new
         n_unsched = n_unsched + (remaining - sched_new)
-        carry = (slot_option, slot_used, n_open, n_unsched)
+        carry = (slot_option, slot_free, n_open, n_unsched)
         return carry, (placed if emit_takes else jnp.sum(take))
 
     C = requests.shape[0]
     n_open0 = jnp.sum(init_option >= 0).astype(jnp.int32)
+    init_free = jnp.where((init_option >= 0)[:, None],
+                          alloc[jnp.maximum(init_option, 0)] - init_used,
+                          0)
     # derive the zero from n_open0 so carry types (incl. shard_map varying-
     # axis annotations) stay consistent between init and body outputs
-    (slot_option, slot_used, n_open, n_unsched), takes = jax.lax.scan(
-        step, (init_option, init_used, n_open0, jnp.zeros_like(n_open0)),
-        (requests, counts, compat, node_cap),
+    (slot_option, slot_free, n_open, n_unsched), takes = jax.lax.scan(
+        step, (init_option, init_free, n_open0, jnp.zeros_like(n_open0)),
+        (requests, counts, compat, node_cap, m_all, ok_all),
         unroll=8)  # amortize per-step sequencing overhead on TPU
+    slot_used = jnp.where((slot_option >= 0)[:, None],
+                          alloc[jnp.maximum(slot_option, 0)] - slot_free,
+                          0)
     return slot_option, slot_used, n_open, n_unsched, takes
 
 
@@ -164,6 +185,41 @@ def class_pack_aggregate_kernel_packed(requests, counts, compat_packed,
     return class_pack_aggregate_kernel(requests, counts, compat, node_cap,
                                        alloc, price, rank, init_option,
                                        init_used, max_nodes)
+
+
+@partial(jax.jit, static_argnames=("max_nodes", "n_pods"))
+def class_pack_assign_kernel(requests, counts, compat_packed, node_cap,
+                             alloc, price, rank, init_option, init_used,
+                             max_nodes: int, n_pods: int):
+    """Pack and decode POD→SLOT assignments on device.
+
+    The takes matrix (C×K placement counts) is the full decode information,
+    but shipping it to the host costs O(C×K) transfer — ~8MB at 50k pods,
+    seconds over a tunneled link. Instead the per-pod slot is derived here:
+    within a class, pod #r lands in the first slot where the class's
+    inclusive take-cumsum exceeds r; flattening the cumsum over (class, slot)
+    keeps it one global searchsorted. Only O(P + K) ints leave the device."""
+    slot_option, slot_used, n_open, n_unsched, takes = class_pack_kernel_packed(
+        requests, counts, compat_packed, node_cap, alloc, price, rank,
+        init_option, init_used, max_nodes, True)
+    C = counts.shape[0]
+    K = max_nodes
+    flat = jnp.cumsum(takes.reshape(-1))                  # (C*K,) global cumsum
+    ends = flat[K - 1::K]                                 # total through class c
+    base = jnp.concatenate([jnp.zeros(1, flat.dtype), ends[:C - 1]])
+    totals = ends - base                                  # per-class scheduled
+    class_ids = jnp.repeat(jnp.arange(C, dtype=jnp.int32), counts,
+                           total_repeat_length=n_pods)
+    cnt_csum = jnp.concatenate(
+        [jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])[:-1]
+    rank_in_class = (jnp.arange(n_pods, dtype=jnp.int32)
+                     - cnt_csum[class_ids])
+    q = base[class_ids] + rank_in_class
+    f = jnp.searchsorted(flat, q, side="right").astype(jnp.int32)
+    slot = f - class_ids * K
+    sched = rank_in_class < totals[class_ids]
+    assignment = jnp.where(sched, slot, -1)
+    return assignment, slot_option, slot_used, n_unsched
 
 
 @partial(jax.jit, static_argnames=("max_nodes",))
@@ -315,51 +371,80 @@ def solve_classpack(problem: Problem,
         return PackingResult(nodes=nodes, unschedulable=[None] * n_unsched,
                              existing_assignments={}, total_price=total)
 
-    slot_option, slot_used, n_open, n_unsched, takes = class_pack_kernel_packed(
-        *pod_args, d_alloc, d_price, d_rank, *init_args(), K, True)
-    slot_option, slot_used, n_unsched, takes = jax.device_get(
-        (slot_option, slot_used, n_unsched, takes))
+    Ppad = pad_to(P)
+    assignment, slot_option, slot_used, n_unsched = jax.device_get(
+        class_pack_assign_kernel(*pod_args, d_alloc, d_price, d_rank,
+                                 *init_args(), K, Ppad))
+    assignment = np.asarray(assignment)[:P]
 
     new_mask = (slot_option >= 0) & (slot_option < O)
     total = float(problem.option_price[slot_option[new_mask]].sum())
 
-    takes = np.asarray(takes)[:C]                      # C×K placement counts
-    # walk classes in solve order, consuming member pod indices in sequence
-    slot_pods: Dict[int, List[int]] = {}
-    slot_classes: Dict[int, List[int]] = {}
-    existing_assignments: Dict[int, int] = {}
-    unschedulable: List[int] = []
-    for row, ci in enumerate(order):
-        members = problem.class_members[ci]
-        pos = 0
-        for k in np.nonzero(takes[row])[0]:
-            n = int(takes[row, k])
-            chunk, pos = members[pos:pos + n], pos + n
-            if int(k) < E:
-                for p in chunk:
-                    existing_assignments[p] = int(k)
-            else:
-                slot_pods.setdefault(int(k), []).extend(chunk)
-                slot_classes.setdefault(int(k), []).append(int(ci))
-        unschedulable.extend(members[pos:])
+    # rows follow the sorted-class order, members consumed in sequence —
+    # the same walk the takes-based decode did, now fully vectorized
+    members_arr = problem.members_arrays()
+    pod_idx = (np.concatenate([members_arr[ci] for ci in order]) if C else
+               np.zeros(0, np.int64))
+    class_of_row = np.repeat(np.asarray(order, np.int64),
+                             problem.class_counts[order]) if C else \
+        np.zeros(0, np.int64)
 
+    sched = assignment >= 0
+    unschedulable = pod_idx[~sched].tolist()
+    ex = sched & (assignment < E)
+    existing_assignments = dict(zip(pod_idx[ex].tolist(),
+                                    assignment[ex].tolist()))
+    new_rows = np.nonzero(sched & (assignment >= E))[0]
+    new_rows = new_rows[np.argsort(assignment[new_rows], kind="stable")]
+    ks = assignment[new_rows]
+    bounds = np.nonzero(np.diff(ks))[0] + 1 if len(ks) else []
+    groups = np.split(new_rows, bounds)
+
+    # one global unique over (slot, class) pairs replaces a per-node
+    # np.unique; both walks below are sorted by slot, so a single pointer
+    # sweep recovers each node's class set
+    Cn = problem.num_classes
+    upq = np.unique(ks.astype(np.int64) * (Cn + 1) + class_of_row[new_rows]) \
+        if len(ks) else np.zeros(0, np.int64)
+    uslot, ucls = upq // (Cn + 1), upq % (Cn + 1)
+
+    # per-node flexible alternatives (and the used ResourceList) are
+    # memoized: full nodes of the same class mix share (option, classes,
+    # used) exactly, so a 5k-node plan computes only a handful of them
+    pool_of_option = np.asarray([o.pool for o in problem.options])
+    alt_memo: Dict[tuple, tuple] = {}
     nodes = []
-    for k in sorted(slot_pods):
+    ui = 0
+    for grp in groups:
+        if not len(grp):
+            continue
+        k = int(assignment[grp[0]])
+        uj = ui
+        while uj < len(uslot) and uslot[uj] == k:
+            uj += 1
+        cls, ui = tuple(ucls[ui:uj]), uj
         oi = int(slot_option[k])
         if not (0 <= oi < O):
             continue
-        # flexible alternatives: jointly compatible with every class on the
-        # node, big enough for its total usage, and from the same pool
-        jc = problem.class_compat[slot_classes[k]].all(axis=0)
-        cap_ok = (problem.option_alloc >= slot_used[k]).all(axis=1)
-        opt_obj = problem.options[oi]
-        same_pool = np.asarray([o.pool == opt_obj.pool for o in problem.options])
-        alt_ids = np.nonzero(jc & cap_ok & same_pool)[0][:max_alternatives]
+        used_vec = slot_used[k]
+        mkey = (oi, cls, used_vec.tobytes())
+        hit = alt_memo.get(mkey)
+        if hit is None:
+            # jointly compatible with every class on the node, big enough
+            # for its total usage, and from the same pool
+            jc = problem.class_compat[list(cls)].all(axis=0)
+            cap_ok = (problem.option_alloc >= used_vec).all(axis=1)
+            same_pool = pool_of_option == problem.options[oi].pool
+            alt_ids = np.nonzero(jc & cap_ok & same_pool)[0][:max_alternatives]
+            hit = alt_memo[mkey] = (
+                [problem.options[a] for a in alt_ids],
+                ResourceList.from_vector(used_vec, problem.axes,
+                                         DEFAULT_SCALES))
         nodes.append(NodeDecision(
             option=problem.options[oi],
-            pod_indices=slot_pods[k],
-            used=ResourceList.from_vector(slot_used[k], problem.axes, DEFAULT_SCALES),
-            alternatives=[problem.options[a] for a in alt_ids],
+            pod_indices=pod_idx[grp].tolist(),
+            used=hit[1],
+            alternatives=hit[0],
         ))
     return PackingResult(nodes=nodes, unschedulable=unschedulable,
                          existing_assignments=existing_assignments,

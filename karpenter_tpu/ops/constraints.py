@@ -182,6 +182,10 @@ class _Rewrites:
                 out.append(pod)
                 continue
             p = copy.copy(pod)
+            # the copy's constraint fields diverge below — drop the
+            # inherited spec caches (ops/tensorize._class_key, pod_is_soft)
+            p.__dict__.pop("_ckey", None)
+            p.__dict__.pop("_soft", None)
             if strip_spread:
                 p.topology_spread = [c for c in pod.topology_spread
                                      if c.when_unsatisfiable != "ScheduleAnyway"]
@@ -495,13 +499,21 @@ def find_batch_topology_violations(problem, packing,
     return out
 
 
+def pod_is_soft(pod: Pod) -> bool:
+    """Whether relaxation levels can change this pod's lowering. Spec-derived
+    and cached (dropped alongside the class key when _Rewrites copies a pod),
+    so 50k-pod batches pay the attribute walk once, at admission."""
+    d = pod.__dict__
+    s = d.get("_soft")
+    if s is None:
+        s = d["_soft"] = bool(
+            pod.preferred_affinity_terms
+            or any(c.when_unsatisfiable == "ScheduleAnyway"
+                   for c in pod.topology_spread)
+            or any(not a.required for a in pod.pod_affinities))
+    return s
+
+
 def has_soft_constraints(pods: Sequence[Pod]) -> bool:
     """Whether relaxing to a higher level could change the outcome."""
-    for p in pods:
-        if p.preferred_affinity_terms:
-            return True
-        if any(c.when_unsatisfiable == "ScheduleAnyway" for c in p.topology_spread):
-            return True
-        if any(not a.required for a in p.pod_affinities):
-            return True
-    return False
+    return any(pod_is_soft(p) for p in pods)

@@ -22,6 +22,8 @@ from ..api import labels as wk
 from ..api.objects import Node, NodeClaim, Pod, PodDisruptionBudget
 from ..api.requirements import Requirements
 from ..api.resources import DEFAULT_AXES, DEFAULT_SCALES, PODS, ResourceList
+from ..ops.constraints import pod_is_soft
+from ..ops.tensorize import _class_key
 from ..api.taints import tolerates_all
 
 _names = itertools.count(1)
@@ -42,6 +44,12 @@ class Cluster:
     # ---- pods ----
     def add_pod(self, pod: Pod) -> Pod:
         self.pods[pod.uid] = pod
+        # admission-time lowering: compute the pod's equivalence-class key
+        # and softness flag here (the informer-decode analog), so the
+        # scheduling hot window (lower_pods + tensorize + solve) never pays
+        # them — every later tensorize of this object hits the caches
+        _class_key(pod)
+        pod_is_soft(pod)
         return pod
 
     def add_pods(self, pods: Sequence[Pod]) -> List[Pod]:

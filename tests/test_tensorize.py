@@ -123,3 +123,70 @@ def test_pad_to_buckets():
     assert pad_to(1) == 256
     assert pad_to(257) == 1024
     assert pad_to(70000) == 131072
+
+
+class TestCatalogSideCache:
+    """The catalog side (options + label tables) is cached across solves,
+    keyed on content so in-place mutations invalidate (VERDICT r1 #4)."""
+
+    def test_same_catalog_reuses_side(self):
+        from karpenter_tpu.ops.tensorize import catalog_side
+        cat = small_catalog()
+        pools = [NodePool()]
+        assert catalog_side(cat, pools) is catalog_side(cat, pools)
+
+    def test_offering_mutation_invalidates(self):
+        from karpenter_tpu.ops.tensorize import catalog_side
+        cat = small_catalog()
+        pools = [NodePool()]
+        s1 = catalog_side(cat, pools)
+        cat[0].offerings[0].available = False
+        s2 = catalog_side(cat, pools)
+        assert s1 is not s2
+        assert len(s2.options) == len(s1.options) - 1
+
+    def test_pool_label_change_invalidates(self):
+        from karpenter_tpu.ops.tensorize import catalog_side
+        cat = small_catalog()
+        pool = NodePool()
+        s1 = catalog_side(cat, [pool])
+        pool.template.labels["team"] = "ml"
+        s2 = catalog_side(cat, [pool])
+        assert s1 is not s2
+
+    def test_class_key_cache_dropped_on_lowered_copies(self):
+        """lower_pods copies must not inherit the original's class key —
+        their constraints differ, so identical keys would wrongly merge
+        lowered and unlowered pods into one class."""
+        from karpenter_tpu.ops.constraints import lower_pods
+        from karpenter_tpu.ops.tensorize import _class_key
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+        pods = [Pod(requests=ResourceList({CPU: 100}),
+                    labels={"app": "web"},
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=wk.ZONE, max_skew=1,
+                        label_selector={"app": "web"})])
+                for _ in range(6)]
+        keys_before = {_class_key(p) for p in pods}
+        lowered = lower_pods(pods, option_zones=("zone-a", "zone-b"),
+                             zone_rank={"zone-a": 1.0, "zone-b": 1.0})
+        changed = [p for p in lowered if p.required_affinity_terms]
+        assert changed, "spread lowering should rewrite some pods"
+        for p in changed:
+            assert _class_key(p) not in keys_before
+
+    def test_filtered_catalog_memoized_for_simulations(self):
+        """Disruption's price-capped catalogs return the same list object
+        per (catalog, cap), so the tensorize catalog-side cache hits across
+        repeated simulations instead of churning."""
+        from karpenter_tpu.catalog.generate import generate_catalog
+        from karpenter_tpu.cloud import FakeCloud, CloudProvider
+        from karpenter_tpu.controllers.disruption import DisruptionController
+        from karpenter_tpu.state import Cluster
+        provider = CloudProvider(FakeCloud(), generate_catalog(8))
+        dc = DisruptionController(provider, Cluster(), [NodePool()])
+        a = dc._filtered_catalog(0.5)
+        b = dc._filtered_catalog(0.5)
+        assert a is b
+        from karpenter_tpu.ops.tensorize import catalog_side
+        assert catalog_side(a, [NodePool()]) is catalog_side(b, [NodePool()])
