@@ -113,69 +113,11 @@ class NodeClassController:
 
 
 # ---------------------------------------------------------------------------
-# Admission: defaulting + validation (webhook analogs,
-# /root/reference/pkg/webhooks/webhooks.go:44-63 +
-# /root/reference/pkg/apis/v1beta1/ec2nodeclass_validation.go)
+# Admission: defaulting + validation moved to karpenter_tpu.api.admission
+# (webhook analogs, /root/reference/pkg/webhooks/webhooks.go:44-63).
+# Re-exported here for compatibility with existing imports.
 # ---------------------------------------------------------------------------
 
-class ValidationError(ValueError):
-    pass
-
-
-def default_nodeclass(nodeclass: NodeClass) -> NodeClass:
-    """Defaulting webhook analog: fill family and block-device defaults."""
-    if not nodeclass.image_family:
-        nodeclass.image_family = "standard"
-    if nodeclass.block_device_gib <= 0:
-        nodeclass.block_device_gib = 20
-    return nodeclass
-
-
-def validate_nodeclass(nodeclass: NodeClass) -> None:
-    """Validation webhook analog (ec2nodeclass_validation.go): reject specs
-    that cannot launch."""
-    from ..providers.imagefamily import FAMILIES
-    errs = []
-    if nodeclass.image_family not in FAMILIES:
-        errs.append(f"unknown image family {nodeclass.image_family!r} "
-                    f"(want one of {FAMILIES})")
-    if nodeclass.image_family == "custom" and not nodeclass.image_selector:
-        errs.append("custom image family requires an image selector")
-    if nodeclass.image_family == "config" and \
-            nodeclass.user_data.lstrip().startswith("MIME-Version"):
-        errs.append("config family user data must be key=value settings, "
-                    "not MIME")
-    if nodeclass.block_device_gib < 1:
-        errs.append("block device must be >= 1 GiB")
-    for sel_name, sel in (("subnet_selector", nodeclass.subnet_selector),
-                          ("security_group_selector",
-                           nodeclass.security_group_selector),
-                          ("image_selector", nodeclass.image_selector)):
-        for k in sel:
-            if not k:
-                errs.append(f"{sel_name} has an empty key")
-    if errs:
-        raise ValidationError("; ".join(errs))
-
-
-def validate_nodepool(nodepool) -> None:
-    """NodePool validation analog (karpenter.sh_nodepools.yaml CEL rules):
-    restricted-domain labels, sane disruption config, weight bounds."""
-    from ..api import labels as wk
-    from ..api.requirements import Requirements
-    errs = []
-    if nodepool.weight < 0 or nodepool.weight > 100:
-        errs.append(f"weight {nodepool.weight} outside [0, 100]")
-    d = nodepool.disruption
-    if d.consolidation_policy not in ("WhenUnderutilized", "WhenEmpty"):
-        errs.append(f"unknown consolidation policy {d.consolidation_policy!r}")
-    if d.consolidation_policy == "WhenEmpty" and d.consolidate_after_s is None:
-        errs.append("WhenEmpty requires consolidate_after_s")
-    if d.expire_after_s is not None and d.expire_after_s <= 0:
-        errs.append("expire_after_s must be positive")
-    restricted = (wk.NODEPOOL, wk.NODE_INITIALIZED)
-    for k in list(nodepool.template.labels) + list(nodepool.template.requirements):
-        if k in restricted:
-            errs.append(f"label {k} is restricted")
-    if errs:
-        raise ValidationError("; ".join(errs))
+from ..api.admission import (ValidationError, default_nodeclass,  # noqa: E402,F401
+                             default_nodepool, validate_nodeclass,
+                             validate_nodeclass_update, validate_nodepool)
