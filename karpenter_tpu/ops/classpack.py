@@ -397,52 +397,82 @@ def solve_classpack(problem: Problem,
     new_rows = np.nonzero(sched & (assignment >= E))[0]
     new_rows = new_rows[np.argsort(assignment[new_rows], kind="stable")]
     ks = assignment[new_rows]
-    bounds = np.nonzero(np.diff(ks))[0] + 1 if len(ks) else []
-    groups = np.split(new_rows, bounds)
+    # node boundaries by vectorized edge-detect: rows are slot-sorted, so
+    # each node is one contiguous run (np.split's per-group array machinery
+    # costs ~15ms at 5k nodes; slicing one pre-built list costs ~nothing)
+    starts = np.nonzero(np.diff(ks, prepend=np.int32(-1)))[0]
+    ends = np.append(starts[1:], len(ks))
+    node_slots = ks[starts] if len(starts) else np.zeros(0, np.int32)
 
     # one global unique over (slot, class) pairs replaces a per-node
-    # np.unique; both walks below are sorted by slot, so a single pointer
-    # sweep recovers each node's class set
+    # np.unique; searchsorted then yields every node's class-set span
     Cn = problem.num_classes
     upq = np.unique(ks.astype(np.int64) * (Cn + 1) + class_of_row[new_rows]) \
         if len(ks) else np.zeros(0, np.int64)
     uslot, ucls = upq // (Cn + 1), upq % (Cn + 1)
+    cls_starts = np.searchsorted(uslot, node_slots, side="left")
+    cls_ends = np.searchsorted(uslot, node_slots, side="right")
+
+    # hot loop below runs once per node (~5-6k at 50k pods): stage every
+    # array it touches as plain Python lists — list indexing/slicing is an
+    # order of magnitude cheaper than per-element numpy scalar access
+    pod_sorted = pod_idx[new_rows].tolist()
+    oi_l = slot_option[node_slots].tolist()
+    used_l = slot_used[node_slots].tolist()
+    starts_l, ends_l = starts.tolist(), ends.tolist()
+    cs_l, ce_l = cls_starts.tolist(), cls_ends.tolist()
+    ucls_l = ucls.tolist()
+    options_l = problem.options
 
     # per-node flexible alternatives (and the used ResourceList) are
     # memoized: full nodes of the same class mix share (option, classes,
-    # used) exactly, so a 5k-node plan computes only a handful of them
+    # used) exactly, so a 5k-node plan computes only a handful of them.
+    # The miss path dominated decode (~200µs each at 50k pods): the fixes
+    # below — per-pool masks computed once (an object-dtype string compare
+    # over the catalog is ~100µs alone), packed-bit AND for joint compat,
+    # and a capacity compare kept in option_alloc's own dtype — take a
+    # miss to ~30µs.
     pool_of_option = np.asarray([o.pool for o in problem.options])
+    pool_masks: Dict[object, np.ndarray] = {}
+    compat_bits = np.packbits(problem.class_compat, axis=1)
+    n_compat_cols = problem.class_compat.shape[1]
+    option_alloc = problem.option_alloc
     alt_memo: Dict[tuple, tuple] = {}
     nodes = []
-    ui = 0
-    for grp in groups:
-        if not len(grp):
-            continue
-        k = int(assignment[grp[0]])
-        uj = ui
-        while uj < len(uslot) and uslot[uj] == k:
-            uj += 1
-        cls, ui = tuple(ucls[ui:uj]), uj
-        oi = int(slot_option[k])
+    for i in range(len(oi_l)):
+        oi = oi_l[i]
         if not (0 <= oi < O):
             continue
-        used_vec = slot_used[k]
-        mkey = (oi, cls, used_vec.tobytes())
+        cls = tuple(ucls_l[cs_l[i]:ce_l[i]])
+        mkey = (oi, cls, tuple(used_l[i]))
         hit = alt_memo.get(mkey)
         if hit is None:
             # jointly compatible with every class on the node, big enough
             # for its total usage, and from the same pool
-            jc = problem.class_compat[list(cls)].all(axis=0)
-            cap_ok = (problem.option_alloc >= used_vec).all(axis=1)
-            same_pool = pool_of_option == problem.options[oi].pool
-            alt_ids = np.nonzero(jc & cap_ok & same_pool)[0][:max_alternatives]
+            used_vec = np.asarray(used_l[i], dtype=slot_used.dtype)
+            if len(cls) == 1:
+                jc = problem.class_compat[cls[0]]
+            else:
+                jc = np.unpackbits(
+                    np.bitwise_and.reduce(compat_bits[list(cls)], axis=0),
+                    count=n_compat_cols).astype(bool)
+            pool = options_l[oi].pool
+            same_pool = pool_masks.get(pool)
+            if same_pool is None:
+                same_pool = pool_masks[pool] = pool_of_option == pool
+            # compare in option_alloc's own dtype: mixing the int used
+            # vector in promoted every row to float64 (~180µs/miss — the
+            # old decode hot spot)
+            cap_ok = (option_alloc
+                      >= used_vec.astype(option_alloc.dtype)).all(axis=1)
+            alt_ids = np.nonzero(jc & same_pool & cap_ok)[0][:max_alternatives]
             hit = alt_memo[mkey] = (
-                [problem.options[a] for a in alt_ids],
+                [options_l[a] for a in alt_ids],
                 ResourceList.from_vector(used_vec, problem.axes,
                                          DEFAULT_SCALES))
         nodes.append(NodeDecision(
-            option=problem.options[oi],
-            pod_indices=pod_idx[grp].tolist(),
+            option=options_l[oi],
+            pod_indices=pod_sorted[starts_l[i]:ends_l[i]],
             used=hit[1],
             alternatives=hit[0],
         ))
