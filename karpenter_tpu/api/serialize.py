@@ -102,7 +102,11 @@ def _parse_duration(v) -> Optional[float]:
     return float(s)
 
 
-def nodepool_from_manifest(m: Dict) -> NodePool:
+def nodepool_from_manifest(m: Dict, validate: bool = True) -> NodePool:
+    """Manifest → NodePool.  With ``validate`` (the default) the admission
+    webhook semantics run on the result: defaulting then object validation
+    (ValidationError on rejection).  ``validate=False`` is the raw
+    round-trip escape hatch."""
     spec = m.get("spec", {})
     tm = spec.get("template", {})
     tspec = tm.get("spec", {})
@@ -122,12 +126,17 @@ def nodepool_from_manifest(m: Dict) -> NodePool:
         consolidate_after_s=_parse_duration(d.get("consolidateAfter")),
         expire_after_s=_parse_duration(d.get("expireAfter", "Never")),
     )
-    return NodePool(
+    pool = NodePool(
         name=m.get("metadata", {}).get("name", "default"),
         template=template, disruption=disruption,
         limits=ResourceList.parse(spec.get("limits", {}) or {}),
         weight=int(spec.get("weight", 0)),
     )
+    if validate:
+        from .admission import default_nodepool, validate_nodepool
+        pool = default_nodepool(pool)
+        validate_nodepool(pool)
+    return pool
 
 
 # ---------------------------------------------------------------------------
@@ -175,9 +184,13 @@ def _selector_from_terms(terms: List[Dict]) -> Dict[str, str]:
     return out
 
 
-def nodeclass_from_manifest(m: Dict) -> NodeClass:
+def nodeclass_from_manifest(m: Dict, validate: bool = True) -> NodeClass:
+    """Manifest → NodeClass.  With ``validate`` (the default) the admission
+    webhook semantics run on the result: defaulting then object validation
+    (ValidationError on rejection).  ``validate=False`` is the raw
+    round-trip escape hatch."""
     spec = m.get("spec", {})
-    return NodeClass(
+    nc = NodeClass(
         name=m.get("metadata", {}).get("name", "default"),
         image_family=spec.get("imageFamily", "standard"),
         zone_selector=list(spec.get("zones", [])),
@@ -190,6 +203,11 @@ def nodeclass_from_manifest(m: Dict) -> NodeClass:
         tags=dict(spec.get("tags", {})),
         block_device_gib=int(spec.get("blockDeviceGiB", 20)),
     )
+    if validate:
+        from .admission import default_nodeclass, validate_nodeclass
+        nc = default_nodeclass(nc)
+        validate_nodeclass(nc)
+    return nc
 
 
 # ---------------------------------------------------------------------------

@@ -91,6 +91,9 @@ class Batcher(Generic[Req, Res]):
     def __init__(self, options: Options, clock: Callable[[], float] = time.monotonic):
         self.options = options
         self.clock = clock
+        # An injected clock (fake/test) advances independently of real time,
+        # so deadline sleeps must poll it instead of trusting Event timeouts.
+        self._real_clock = clock is time.monotonic
         self.stats = BatchStats()
         self._lock = threading.Lock()
         self._open: Dict[Hashable, _Bucket] = {}
@@ -132,9 +135,12 @@ class Batcher(Generic[Req, Res]):
         """Window clock: wake at the earlier of idle/max deadline, then run
         the batch (batcher.go waitForIdle:161-182 + runCalls:184).
 
-        Sleeps the FULL computed wait: a new add() can only push the idle
-        deadline later, never earlier, so no poll is needed — the only early
-        wake is the max_items close, signaled via closed_event."""
+        With the default real-time clock, sleeps the FULL computed wait: a
+        new add() can only push the idle deadline later, never earlier, so no
+        poll is needed — the only early wake is the max_items close, signaled
+        via closed_event.  With an injected clock the computed wait is in
+        *fake* seconds, so the sleep polls the clock on a short real-time
+        slice instead of stalling the caller a full real window."""
         while True:
             with self._lock:
                 if bucket.closed:
@@ -147,7 +153,8 @@ class Batcher(Generic[Req, Res]):
                     self._close(key, bucket)
                     break
                 wait = deadline - now
-            bucket.closed_event.wait(timeout=wait)
+            bucket.closed_event.wait(
+                timeout=wait if self._real_clock else min(wait, 0.001))
         self._run(bucket)
 
     def _run(self, bucket: _Bucket) -> None:

@@ -406,10 +406,13 @@ class DisruptionController:
             chosen = result.nodes[0]
             if (c.node.capacity_type == wk.CAPACITY_TYPE_SPOT
                     and chosen.option.capacity_type == wk.CAPACITY_TYPE_SPOT):
-                pool_spot_cheaper = sum(
-                    1 for o in problem.options
+                # distinct cheaper spot TYPES, matching spot_alts' dedup —
+                # counting zone-expanded options would inflate the clamp and
+                # permanently block spot→spot moves on multi-zone catalogs
+                pool_spot_cheaper = len({
+                    o.instance_type for o in problem.options
                     if o.capacity_type == wk.CAPACITY_TYPE_SPOT
-                    and o.pool == chosen.option.pool and o.price < c.price)
+                    and o.pool == chosen.option.pool and o.price < c.price})
                 floor = min(self.spot_min_flexibility, pool_spot_cheaper)
                 spot_alts = {a.instance_type for a in chosen.alternatives
                              if a.capacity_type == wk.CAPACITY_TYPE_SPOT

@@ -145,23 +145,23 @@ class Operator:
         and register into the live controller state (dict shared with the
         provisioner/disruption controllers).  Legacy alpha kinds convert
         first (karpenter-convert semantics).  Returns the registered object."""
+        from ..api.admission import validate_manifest, validate_nodeclass_update
         from ..api.legacy import convert_manifest
         from ..api.serialize import (nodeclass_from_manifest,
                                      nodepool_from_manifest)
-        from ..controllers.nodeclass import (default_nodeclass,
-                                             validate_nodeclass,
-                                             validate_nodepool)
         manifest = convert_manifest(manifest)
+        validate_manifest(manifest)
         kind = manifest.get("kind")
         if kind == "NodePool":
-            pool = nodepool_from_manifest(manifest)
-            validate_nodepool(pool)
+            pool = nodepool_from_manifest(manifest)  # defaults + validates
             self.nodepools[pool.name] = pool
             log.info("applied NodePool %s", pool.name)
             return pool
         if kind == "NodeClass":
-            nc = default_nodeclass(nodeclass_from_manifest(manifest))
-            validate_nodeclass(nc)
+            nc = nodeclass_from_manifest(manifest)   # defaults + validates
+            original = self.node_classes.get(nc.name)
+            if original is not None:
+                validate_nodeclass_update(original, nc)
             self.node_classes[nc.name] = nc
             log.info("applied NodeClass %s", nc.name)
             return nc

@@ -355,11 +355,11 @@ class _CatalogSide:
 
 
 # LRU of catalog sides. Keyed on instance-type identity PLUS the mutable
-# content (offering price/availability, pool spec), so in-place mutations —
-# ICE masking in tests, pool edits — can't serve stale options. Identity
-# suffices for the immutable parts because callers that rebuild types
-# (provider seq bumps, disruption's price-filtered catalogs) construct new
-# objects; repeated-solve hits come from those layers memoizing their lists.
+# content the tensorizer consumes (offering price/availability, allocatable
+# resources, requirements, pool spec), so in-place mutations — ICE masking
+# in tests, capacity/requirement edits, pool edits — can't serve stale
+# tensors. The content hashes cost ~µs/type; repeated-solve hits come from
+# upper layers memoizing their catalog lists.
 _CATSIDE_CACHE: Dict[tuple, _CatalogSide] = {}
 _CATSIDE_MAX = 8
 
@@ -369,7 +369,9 @@ def _catside_fingerprint(catalog: Sequence[InstanceType],
                          axes: Tuple[str, ...]) -> tuple:
     cat_sig = tuple((id(it),
                      tuple((o.zone, o.capacity_type, o.price, o.available)
-                           for o in it.offerings))
+                           for o in it.offerings),
+                     tuple(sorted(it.allocatable.items())),
+                     hash(frozenset(it.requirements.items())))
                     for it in catalog)
     pool_sig = tuple(
         (p.name, p.weight,

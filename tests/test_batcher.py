@@ -214,3 +214,45 @@ class TestBatchedCloudFacade:
         assert len(bc.describe_instances()) == 5
         # attribute passthrough
         assert bc.running() and hasattr(bc, "interrupt")
+
+
+class TestInjectedClock:
+    """Deadlines computed from an injected clock must be honored without
+    stalling real wall-time (round-2 advisor: the flusher slept the full
+    real window while the fake clock stood still)."""
+
+    def test_fake_clock_window_closes_when_clock_advances(self):
+        t = [0.0]
+        calls = []
+        b = Batcher(Options(name="fake", idle_timeout=10.0, max_timeout=60.0,
+                            max_items=100, request_hasher=lambda r: "all",
+                            batch_executor=lambda reqs: [calls.append(len(reqs))
+                                                         or len(reqs)] * len(reqs)),
+                    clock=lambda: t[0])
+        start = time.monotonic()
+        results, errors = [None], [None]
+
+        def caller():
+            try:
+                results[0] = b.add("x")
+            except BaseException as e:
+                errors[0] = e
+
+        th = threading.Thread(target=caller)
+        th.start()
+        time.sleep(0.05)            # window open, fake deadline 10s away
+        assert results[0] is None   # not flushed yet
+        t[0] = 11.0                 # fake idle deadline passes
+        th.join(timeout=5)
+        elapsed = time.monotonic() - start
+        assert errors[0] is None
+        assert results[0] == 1
+        # honored the fake deadline promptly instead of sleeping 10 real s
+        assert elapsed < 5.0
+        assert calls == [1]
+
+    def test_real_clock_still_sleeps_full_window(self):
+        b = make_batcher(lambda reqs: list(reqs), idle=0.05)
+        start = time.monotonic()
+        assert b.add("x") == "x"
+        assert 0.04 <= time.monotonic() - start < 2.0

@@ -483,3 +483,57 @@ class TestStaticHashDrift:
         rebuilt = p2.list()[0]
         assert rebuilt.node_class_ref == "gpu"
         assert p2.is_drifted(rebuilt) is None  # healthy node is NOT drifted
+
+
+# ---------------------------------------------------------------------------
+# spot→spot flexibility floor
+# ---------------------------------------------------------------------------
+
+def test_spot_to_spot_flexibility_counts_types_not_zone_options():
+    """The ≥15-alternatives floor is clamped by how many cheaper spot TYPES
+    the catalog has — zone-expanded option counting would set floor=15 here
+    (2 types × 8 zones ≥ 15 options) and permanently block the move."""
+    zones = tuple(f"zone-{c}" for c in "abcdefgh")
+    catalog = [
+        make_type("s.big", 16, 32, 1.00, zones=zones, spot_discount=0.5),
+        make_type("s.a", 4, 8, 0.40, zones=zones, spot_discount=0.5),
+        make_type("s.b", 4, 8, 0.44, zones=zones, spot_discount=0.5),
+    ]
+    clock, cloud, provider, cluster, prov, ctrl = env(catalog=catalog)
+    big = cpu_pod(cpu_m=12000, mem_mib=24000)
+    tiny = cpu_pod(cpu_m=200, mem_mib=256)
+    provision(cluster, prov, [big, tiny])
+    node = next(iter(cluster.nodes.values()))
+    assert node.instance_type == "s.big"
+    assert node.capacity_type == wk.CAPACITY_TYPE_SPOT
+    cluster.delete_pod(big)
+    res = ctrl.reconcile()
+    assert res.action is not None and res.action.name == "replace/consolidation"
+    new = next(iter(cluster.nodes.values()))
+    assert new.capacity_type == wk.CAPACITY_TYPE_SPOT
+    assert new.price < node.price
+
+
+def test_spot_to_spot_still_blocked_below_catalog_clamp():
+    """With only ONE cheaper spot type the clamped floor is 1... met by the
+    chosen type itself; shrink flexibility to 2 types and demand 15: a pool
+    with 2 cheaper types yields floor=2, and a replacement offering only the
+    chosen type (1 alt) must stay blocked."""
+    zones = tuple(f"zone-{c}" for c in "abcdefgh")
+    catalog = [
+        make_type("s.big", 16, 32, 1.00, zones=zones, spot_discount=0.5),
+        make_type("s.a", 4, 8, 0.40, zones=zones, spot_discount=0.5),
+    ]
+    clock, cloud, provider, cluster, prov, ctrl = env(catalog=catalog)
+    big = cpu_pod(cpu_m=12000, mem_mib=24000)
+    # tiny pod that fits ONLY s.a (not s.b — none exists) → 1 spot alt
+    tiny = cpu_pod(cpu_m=3800, mem_mib=256)
+    provision(cluster, prov, [big, tiny])
+    node = next(iter(cluster.nodes.values()))
+    cluster.delete_pod(big)
+    ctrl.spot_min_flexibility = 2
+    # pool has exactly 1 cheaper spot type (s.a) → floor = min(2, 1) = 1;
+    # chosen IS s.a so the floor is met and the replace goes through: the
+    # clamp keeps small catalogs consolidatable
+    res = ctrl.reconcile()
+    assert res.action is not None and res.action.name == "replace/consolidation"

@@ -386,3 +386,32 @@ class TestApply:
         for kind, schema in crd_schemas().items():
             with open(crds / f"{kind.lower()}.schema.json") as f:
                 assert json.load(f) == schema
+
+    def test_apply_schema_checks_manifest(self):
+        """validate_manifest runs before construction — a document missing
+        required spec fields is rejected at the schema layer."""
+        from karpenter_tpu.controllers.nodeclass import ValidationError
+        op, _ = self._op()
+        with pytest.raises(ValidationError):
+            op.apply({"apiVersion": "karpenter.tpu/v1beta1",
+                      "kind": "NodePool", "metadata": {"name": "x"}})  # no spec
+        with pytest.raises(ValueError):
+            op.apply({"apiVersion": "karpenter.tpu/v1beta1", "kind": "Widget",
+                      "metadata": {"name": "x"}, "spec": {}})  # unknown kind
+
+    def test_apply_enforces_role_immutability(self):
+        """Re-applying a NodeClass may not change its role
+        (validateRoleImmutability, ec2nodeclass_validation.go:287-296)."""
+        from karpenter_tpu.controllers.nodeclass import ValidationError
+        op, _ = self._op()
+        base = {"apiVersion": "karpenter.tpu/v1beta1", "kind": "NodeClass",
+                "metadata": {"name": "web"},
+                "spec": {"imageFamily": "standard", "role": "r1"}}
+        op.apply(base)
+        updated = dict(base, spec=dict(base["spec"], userData="v2"))
+        op.apply(updated)         # same role: fine
+        assert op.node_classes["web"].user_data == "v2"
+        hijack = dict(base, spec=dict(base["spec"], role="r2"))
+        with pytest.raises(ValidationError):
+            op.apply(hijack)
+        assert op.node_classes["web"].role == "r1"

@@ -178,3 +178,35 @@ class TestLegacyConversion:
             capture_output=True, text=True, cwd="/root/repo", check=True)
         docs = list(yaml.safe_load_all(out.stdout))
         assert [d["kind"] for d in docs] == ["NodePool", "NodeClass"]
+
+
+class TestDeserializationAdmission:
+    """serialize.*_from_manifest run webhook defaulting + validation unless
+    the caller opts out with validate=False."""
+
+    def test_nodepool_from_manifest_validates(self):
+        from karpenter_tpu.api.admission import ValidationError
+        bad = {"kind": "NodePool", "metadata": {"name": "x"},
+               "spec": {"weight": 9000, "template": {}}}
+        with pytest.raises(ValidationError):
+            nodepool_from_manifest(bad)
+        raw = nodepool_from_manifest(bad, validate=False)
+        assert raw.weight == 9000
+
+    def test_nodepool_from_manifest_defaults(self):
+        m = {"kind": "NodePool", "metadata": {"name": "x"},
+             "spec": {"template": {}, "disruption": {}}}
+        pool = nodepool_from_manifest(m)
+        assert pool.disruption.consolidation_policy == "WhenUnderutilized"
+        assert pool.template.node_class_ref == "default"
+
+    def test_nodeclass_from_manifest_validates_and_defaults(self):
+        from karpenter_tpu.api.admission import ValidationError
+        nc = nodeclass_from_manifest(
+            {"kind": "NodeClass", "metadata": {"name": "x"}, "spec": {}})
+        assert nc.image_family == "standard"       # defaulted
+        bad = {"kind": "NodeClass", "metadata": {"name": "x"},
+               "spec": {"imageFamily": "custom"}}  # custom needs a selector
+        with pytest.raises(ValidationError):
+            nodeclass_from_manifest(bad)
+        assert nodeclass_from_manifest(bad, validate=False).image_family == "custom"

@@ -190,3 +190,26 @@ class TestCatalogSideCache:
         assert a is b
         from karpenter_tpu.ops.tensorize import catalog_side
         assert catalog_side(a, [NodePool()]) is catalog_side(b, [NodePool()])
+
+
+    def test_allocatable_mutation_invalidates(self):
+        """In-place capacity edits must not serve stale option tensors
+        (round-2 advisor: fingerprint omitted allocatable)."""
+        from karpenter_tpu.ops.tensorize import catalog_side
+        cat = small_catalog()
+        pools = [NodePool()]
+        s1 = catalog_side(cat, pools)
+        cat[0].capacity[CPU] = cat[0].capacity[CPU] * 2
+        cat[0].__dict__.pop("allocatable", None)   # drop cached_property
+        s2 = catalog_side(cat, pools)
+        assert s1 is not s2
+
+    def test_requirements_mutation_invalidates(self):
+        from karpenter_tpu.api.requirements import IN, Requirement
+        from karpenter_tpu.ops.tensorize import catalog_side
+        cat = small_catalog()
+        pools = [NodePool()]
+        s1 = catalog_side(cat, pools)
+        cat[0].requirements["custom/team"] = Requirement("custom/team", IN, ["ml"])
+        s2 = catalog_side(cat, pools)
+        assert s1 is not s2
