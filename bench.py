@@ -160,15 +160,23 @@ def run_consolidation_replay(n_nodes=500, n_types=200, iters=3):
     ctrl = DisruptionController(provider, cluster, pools,
                                 clock=lambda: time.time() + 10_000)
     cands = ctrl.candidates()
-    times = []
+    cap = cands[0].price if cands else None
+    times, probe_times = [], []
     for _ in range(iters):
         t0 = time.perf_counter()
-        ctrl.simulate(cands[:1], allow_new=True,
-                      max_total_price=cands[0].price if cands else None)
+        ctrl.simulate(cands[:1], allow_new=True, max_total_price=cap)
         times.append((time.perf_counter() - t0) * 1000)
+        # the feasibility-probe path the controller's binary search and
+        # single-node screens actually run (decode=False aggregate kernel)
+        t0 = time.perf_counter()
+        ctrl.simulate(cands[:1], allow_new=True, max_total_price=cap,
+                      decode=False)
+        probe_times.append((time.perf_counter() - t0) * 1000)
     p50 = float(np.median(times))
+    probe_p50 = float(np.median(probe_times))
     log(f"[consolidation-replay] nodes={len(cluster.nodes)} "
-        f"candidates={len(cands)} simulate_p50={p50:.1f}ms")
+        f"candidates={len(cands)} simulate_p50={p50:.1f}ms "
+        f"probe_p50={probe_p50:.1f}ms")
     return p50
 
 

@@ -91,9 +91,6 @@ class Batcher(Generic[Req, Res]):
     def __init__(self, options: Options, clock: Callable[[], float] = time.monotonic):
         self.options = options
         self.clock = clock
-        # An injected clock (fake/test) advances independently of real time,
-        # so deadline sleeps must poll it instead of trusting Event timeouts.
-        self._real_clock = clock is time.monotonic
         self.stats = BatchStats()
         self._lock = threading.Lock()
         self._open: Dict[Hashable, _Bucket] = {}
@@ -135,12 +132,15 @@ class Batcher(Generic[Req, Res]):
         """Window clock: wake at the earlier of idle/max deadline, then run
         the batch (batcher.go waitForIdle:161-182 + runCalls:184).
 
-        With the default real-time clock, sleeps the FULL computed wait: a
-        new add() can only push the idle deadline later, never earlier, so no
-        poll is needed — the only early wake is the max_items close, signaled
-        via closed_event.  With an injected clock the computed wait is in
-        *fake* seconds, so the sleep polls the clock on a short real-time
-        slice instead of stalling the caller a full real window."""
+        The computed wait is in CLOCK seconds, which for an injected
+        fake/test clock bears no relation to real time — so the sleep is
+        capped at a 50ms real-time slice and the deadline re-checked against
+        the clock on every wake.  Real-clock windows here are 35ms-1s, so
+        the cap costs at most ~20 wakeups/s per open bucket (buckets live
+        one window) while bounding any injected clock's deadline latency to
+        one slice; no clock-kind heuristic that a fake clock's step pattern
+        could defeat.  Early close on max_items is signaled via
+        closed_event."""
         while True:
             with self._lock:
                 if bucket.closed:
@@ -153,8 +153,7 @@ class Batcher(Generic[Req, Res]):
                     self._close(key, bucket)
                     break
                 wait = deadline - now
-            bucket.closed_event.wait(
-                timeout=wait if self._real_clock else min(wait, 0.001))
+            bucket.closed_event.wait(timeout=min(wait, 0.05))
         self._run(bucket)
 
     def _run(self, bucket: _Bucket) -> None:

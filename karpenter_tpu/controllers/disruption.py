@@ -218,11 +218,19 @@ class DisruptionController:
 
     def simulate(self, excluded: Sequence[Candidate],
                  allow_new: bool = False,
-                 max_total_price: Optional[float] = None
+                 max_total_price: Optional[float] = None,
+                 decode: bool = True
                  ) -> Tuple[Problem, PackingResult, List[Node]]:
         """Would the excluded candidates' pods schedule on the surviving
         nodes [+ cheaper new capacity]?  One batched solve over dense arrays
-        (SURVEY.md §7.6) instead of the reference's per-candidate replay."""
+        (SURVEY.md §7.6) instead of the reference's per-candidate replay.
+
+        ``decode=False`` is the feasibility-probe mode (aggregate kernel, no
+        per-pod binding, no batch-topology audit): a 10s-cadence controller
+        doing dozens of binary-search probes can't afford per-probe decode —
+        only the ONE accepted action needs real assignments
+        (/root/reference/designs/consolidation.md:61-67's 15s/node budget
+        implies probes must be cheap)."""
         pods = [p for c in excluded for p in c.reschedulable]
         catalog = self._filtered_catalog(max_total_price) if allow_new else []
         pools = list(self.nodepools.values())
@@ -251,14 +259,19 @@ class DisruptionController:
             problem,
             existing_alloc=alloc if len(node_list) else None,
             existing_used=used if len(node_list) else None,
-            existing_compat=compat if len(node_list) else None)
-        # intra-batch anti-affinity/spread the masks can't express: a
-        # violated placement disqualifies the whole action (the reference's
-        # simulation would simply fail to schedule the pod), so count the
-        # violating pods as unschedulable rather than executing a bad bind
-        violations = find_batch_topology_violations(problem, result, node_list)
-        if violations:
-            result.unschedulable = sorted(set(result.unschedulable) | violations)
+            existing_compat=compat if len(node_list) else None,
+            decode=decode)
+        if decode:
+            # intra-batch anti-affinity/spread the masks can't express: a
+            # violated placement disqualifies the whole action (the
+            # reference's simulation would simply fail to schedule the pod),
+            # so count the violating pods as unschedulable rather than
+            # executing a bad bind
+            violations = find_batch_topology_violations(problem, result,
+                                                        node_list)
+            if violations:
+                result.unschedulable = sorted(
+                    set(result.unschedulable) | violations)
         return problem, result, node_list
 
     # ------------------------------------------------------------------
@@ -361,8 +374,10 @@ class DisruptionController:
 
         # multi-node / single-node DELETE: pods fit on surviving nodes alone.
         # The union of a subset's evictions must clear the PDB budgets too —
-        # per-node checks in candidates() don't compose.
-        lo, hi, best = 1, len(cands), None
+        # per-node checks in candidates() don't compose.  Probes run the
+        # aggregate kernel (decode=False); only the winning prefix pays for
+        # per-pod decode + the batch-topology audit.
+        lo, hi, best_mid = 1, len(cands), 0
         while lo <= hi:
             mid = (lo + hi) // 2
             subset = cands[:mid]
@@ -370,22 +385,43 @@ class DisruptionController:
             if not self.cluster.evictable(union):
                 hi = mid - 1
                 continue
-            problem, result, survivors = self.simulate(subset, allow_new=False)
+            _, result, _ = self.simulate(subset, allow_new=False, decode=False)
             if not result.unschedulable and not result.nodes:
-                best = Action(kind="delete", reason="consolidation",
-                              candidates=subset, simulation=result,
-                              problem=problem, surviving_nodes=survivors)
+                best_mid = mid
                 lo = mid + 1
             else:
                 hi = mid - 1
+        # the aggregate probe is optimistic about intra-batch topology
+        # (spread/anti-affinity audits need assignments): decode the winner
+        # — common case, ONE decoded solve total.  If the audit rejects it,
+        # rerun the binary search with decoded probes over the remaining
+        # range: the pre-probe algorithm, paid only when audits bite.
+        best = self._decoded_delete_action(cands[:best_mid]) if best_mid else None
+        if best is None and best_mid > 1:
+            lo, hi = 1, best_mid - 1
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                a = self._decoded_delete_action(cands[:mid])
+                if a is not None:
+                    best = a
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
         if best is not None:
             return best
 
         # single-node pass (non-prefix candidates the binary search missed):
         # DELETE if the solver lands every pod on survivors, else REPLACE
-        # with ONE strictly-cheaper node
+        # with ONE strictly-cheaper node.  Aggregate screen first; decode
+        # only accepted candidates.
         for c in cands:
             if not c.reschedulable:
+                continue
+            _, screen, _ = self.simulate(
+                [c], allow_new=True, max_total_price=c.price, decode=False)
+            if screen.unschedulable or len(screen.nodes) > 1:
+                continue
+            if screen.nodes and screen.total_price >= c.price:
                 continue
             problem, result, survivors = self.simulate(
                 [c], allow_new=True, max_total_price=c.price)
@@ -424,6 +460,19 @@ class DisruptionController:
                           candidates=[c], simulation=result, problem=problem,
                           surviving_nodes=survivors)
         return None
+
+    def _decoded_delete_action(self, subset: List[Candidate]) -> Optional[Action]:
+        """Fully-decoded delete feasibility (incl. the batch-topology audit)
+        for one candidate prefix; None if the subset can't be deleted."""
+        union = [p for c in subset for p in c.reschedulable]
+        if not self.cluster.evictable(union):
+            return None
+        problem, result, survivors = self.simulate(subset, allow_new=False)
+        if result.unschedulable or result.nodes:
+            return None
+        return Action(kind="delete", reason="consolidation", candidates=subset,
+                      simulation=result, problem=problem,
+                      surviving_nodes=survivors)
 
     def _consolidatable(self, c: Candidate) -> bool:
         now = self.clock()

@@ -367,11 +367,17 @@ _CATSIDE_MAX = 8
 def _catside_fingerprint(catalog: Sequence[InstanceType],
                          nodepools: Sequence[NodePool],
                          axes: Tuple[str, ...]) -> tuple:
+    # requirements are keyed by an int hash over EVERY Requirement field
+    # (not Requirement.__hash__, which omits min_values) — full content
+    # tuples would triple the cost of this hot-path fingerprint, and a
+    # spurious miss from dict-order variation only costs a rebuild
     cat_sig = tuple((id(it),
                      tuple((o.zone, o.capacity_type, o.price, o.available)
                            for o in it.offerings),
                      tuple(sorted(it.allocatable.items())),
-                     hash(frozenset(it.requirements.items())))
+                     hash(tuple((k, r.complement, tuple(r.values),
+                                 r.greater_than, r.less_than, r.min_values)
+                                for k, r in it.requirements.items())))
                     for it in catalog)
     pool_sig = tuple(
         (p.name, p.weight,

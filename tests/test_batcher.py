@@ -256,3 +256,48 @@ class TestInjectedClock:
         start = time.monotonic()
         assert b.add("x") == "x"
         assert 0.04 <= time.monotonic() - start < 2.0
+
+    def test_wrapped_real_clock_does_not_busy_poll(self):
+        """A lambda-wrapped real clock must not be degraded to a 1kHz
+        busy-poll — the slice-capped wait costs ~20 wakeups/s at most."""
+        import time as _t
+        calls = [0]
+
+        def wrapped():
+            calls[0] += 1
+            return _t.monotonic() + 5000.0   # offset real clock
+
+        b = Batcher(Options(name="wrapped", idle_timeout=0.2, max_timeout=1.0,
+                            max_items=100, request_hasher=lambda r: "all",
+                            batch_executor=lambda reqs: list(reqs)),
+                    clock=wrapped)
+        assert b.add("x") == "x"
+        # busy-polling a 200ms window at 1kHz would call the clock ~400+
+        # times; the 50ms slice cap calls it a handful of times
+        assert calls[0] < 50
+
+    def test_fake_clock_step_jump_does_not_buy_real_sleep(self):
+        """A fake clock advanced in STEPS short of the deadline must keep
+        the flusher polling — a jump inside one poll window must not flip it
+        into a full-length real sleep on fake-seconds."""
+        t = [0.0]
+        b = Batcher(Options(name="steps", idle_timeout=10.0, max_timeout=60.0,
+                            max_items=100, request_hasher=lambda r: "all",
+                            batch_executor=lambda reqs: list(reqs)),
+                    clock=lambda: t[0])
+        start = time.monotonic()
+        done = threading.Event()
+        out = [None]
+
+        def caller():
+            out[0] = b.add("x")
+            done.set()
+
+        threading.Thread(target=caller).start()
+        # advance in 1-fake-second steps: 11 steps pass the idle deadline
+        for _ in range(11):
+            time.sleep(0.02)
+            t[0] += 1.0
+        assert done.wait(timeout=5.0), "flusher stalled on a real-time sleep"
+        assert out[0] == "x"
+        assert time.monotonic() - start < 5.0
