@@ -247,6 +247,8 @@ class NodeClaim:
     taints: List[Taint] = field(default_factory=list)
     node_class_ref: str = "default"
     node_class_hash: str = ""  # nodeclass static hash at launch (drift input)
+    image_id: str = ""         # image the node booted from (AMI-drift input,
+                               # /root/reference/pkg/cloudprovider/drift.go:42-67)
     labels: Dict[str, str] = field(default_factory=dict)
     name: str = field(default_factory=lambda: _uid("nodeclaim"))
     # lifecycle (launch → registered → initialized), §2.2 NodeClaim lifecycle

@@ -347,6 +347,7 @@ class CloudProvider:
         claim.capacity_type = inst.capacity_type
         claim.price = inst.price
         claim.launched_at = inst.launched_at
+        claim.image_id = inst.image_id
         claim.labels.update(self._instance_labels(inst, claim))
         self._claims_by_provider_id[inst.id] = claim
         return claim
@@ -407,6 +408,10 @@ class CloudProvider:
         claim.capacity_type = inst.capacity_type
         claim.price = inst.price
         claim.launched_at = inst.launched_at
+        # the boot image is durable on the instance record itself (EC2
+        # DescribeInstances returns ImageId), so hydration restores the
+        # AMI-drift input with no extra tag
+        claim.image_id = inst.image_id
         # labels/taints must survive hydration or recovered nodes reject
         # every selector/affinity pod (compat fails closed on absent keys):
         # custom labels come back from the tag, well-known from the catalog
@@ -446,6 +451,12 @@ class CloudProvider:
                 current = nc.hash_annotation or static_hash(nc)
                 if claim.node_class_hash != current:
                     return "NodeClassHashDrifted"
+            # AMI drift (drift.go:42-67 isNodeClassDrifted → amiDrifted): a
+            # newer image published under the same selector resolves into
+            # status_images and drifts every node booted from the old one
+            if (claim.image_id and nc.status_images
+                    and claim.image_id not in nc.status_images):
+                return "ImageDrifted"
             if nc.status_zones and claim.zone not in nc.status_zones:
                 return "ZoneDrifted"
         return None

@@ -145,3 +145,73 @@ def test_fleet_tags_are_pool_scoped_so_batching_merges():
         assert inst.tags["karpenter.sh/nodeclaim"] == c.name
         assert inst.tags["Name"] == f"default/{c.name}"
         assert inst.tags["karpenter.sh/nodepool"] == "default"
+
+
+def test_new_image_under_same_selector_drifts_and_replaces_node(stack):
+    """AMI drift end-to-end (/root/reference/pkg/cloudprovider/drift.go:42-67):
+    a newer image published under the same resolution path drifts nodes
+    launched from the old one, and the disruption controller replaces them."""
+    from karpenter_tpu.api.objects import NodePool, Pod
+    from karpenter_tpu.api.resources import ResourceList
+    from karpenter_tpu.controllers import Provisioner
+    from karpenter_tpu.controllers.disruption import DisruptionController
+    from karpenter_tpu.controllers.nodeclass import NodeClassController
+    from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+    from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+    from karpenter_tpu.cloud.services import FakeIAM
+    from karpenter_tpu.state import Cluster
+
+    cloud, provider, subnets = stack
+    nc = provider.node_classes["default"]
+    image_provider = provider.launch_templates.resolver.image_provider
+    ncc = NodeClassController(
+        subnets=subnets, security_groups=SecurityGroupProvider(cloud),
+        images=image_provider,
+        instance_profiles=InstanceProfileProvider(FakeIAM(), "kc"),
+        cluster=None)
+    ncc.reconcile(nc)
+    assert nc.status_images == ["img-1"]
+
+    cluster = Cluster()
+    pools = [NodePool()]
+    prov = Provisioner(provider, cluster, pools)
+    cluster.add_pods([Pod(requests=ResourceList.parse(
+        {"cpu": "500m", "memory": "512Mi"}))])
+    res = prov.provision()
+    assert not res.unschedulable
+    claim = res.launched[0]
+    assert claim.image_id == "img-1"
+    assert provider.is_drifted(claim) is None
+
+    # publish a newer image under the same resolution path
+    from karpenter_tpu.cloud.fake import ImageInfo
+    cloud.images.append(ImageInfo("img-2", "standard-v2", "amd64", 500.0))
+    image_provider.params.parameters[
+        "/karpenter-tpu/images/standard/1.28/amd64/latest"] = "img-2"
+    image_provider.reset_cache()
+    ncc.reconcile(nc)
+    assert nc.status_images == ["img-2"]
+    assert provider.is_drifted(claim) == "ImageDrifted"
+
+    ctrl = DisruptionController(provider, cluster, pools, stabilization_s=0.0)
+    out = ctrl.reconcile()
+    assert out.action is not None and out.action.reason == "drift"
+    assert len(cluster.nodes) == 1
+    new_node = next(iter(cluster.nodes.values()))
+    assert cloud.get_instance(new_node.provider_id).image_id == "img-2"
+
+
+def test_image_id_survives_hydration(stack):
+    """Restart recovery restores the boot image from the instance record, so
+    drift verdicts survive an operator restart."""
+    from karpenter_tpu.api.objects import NodeClaim
+    cloud, provider, _ = stack
+    claim = provider.create(NodeClaim(nodepool="default"))
+    assert claim.image_id == "img-1"
+    from karpenter_tpu.catalog.generate import generate_catalog
+    p2 = CloudProvider(cloud, generate_catalog(12), cluster_name="kc",
+                       node_classes=provider.node_classes)
+    rebuilt = p2.list()[0]
+    assert rebuilt.image_id == "img-1"
+    provider.node_classes["default"].status_images = ["img-9"]
+    assert p2.is_drifted(rebuilt) == "ImageDrifted"
