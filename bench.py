@@ -83,37 +83,15 @@ def time_solve(pods, catalog, pools, iters=5):
 
 
 def cost_lower_bound(prob):
-    """LP-relaxation lower bound on achievable cost: for every resource r,
-    any packing pays at least total_demand_r x the best price-per-unit-r
-    across launchable options; the max over resources is a valid bound
-    (BASELINE.md packing-cost-vs-optimal target)."""
-    if prob.num_options == 0 or prob.num_classes == 0:
-        return 0.0
-    # demand counts only classes with a compatible option — infeasible pods
-    # never enter total_price, so including them would inflate the bound and
-    # could report cost ratios below 1
-    feas_cls = prob.class_compat.any(axis=1)
-    demand = (prob.class_requests[feas_cls]
-              * prob.class_counts[feas_cls, None]).sum(axis=0)
-    alloc, price = prob.option_alloc, prob.option_price
-    lb = 0.0
-    for r in range(alloc.shape[1]):
-        col = alloc[:, r]
-        ok = col > 0
-        if ok.any() and demand[r] > 0:
-            lb = max(lb, float(demand[r]) * float((price[ok] / col[ok]).min()))
-    # tighter per-pod fractional bound: a pod of class c occupies at least
-    # share_j = max_r(req_r / alloc_jr) of an option-j node, so it costs at
-    # least min over compatible j of price_j * share_j
-    with np.errstate(divide="ignore", invalid="ignore"):
-        shares = np.where(alloc[None, :, :] > 0,
-                          prob.class_requests[:, None, :] / alloc[None, :, :],
-                          np.inf).max(axis=2)                    # C x O
-    per_pod = np.where(prob.class_compat, price[None, :] * shares, np.inf)
-    best = per_pod.min(axis=1)                                   # C
-    feasible = np.isfinite(best)
-    lb2 = float((best[feasible] * prob.class_counts[feasible]).sum())
-    return max(lb, lb2)
+    """Certified lower bound on achievable cost: the EXACT optimum of the
+    class-granular LP relaxation (scipy/HiGHS, off the clock), falling back
+    to a dual-feasibility certificate when scipy is absent.  Replaces the
+    old per-pod max-share heuristic, which was NOT a valid bound
+    (complementary pods can share a node while their max-shares sum past 1,
+    so summed imputed costs could exceed the true optimum) — see
+    karpenter_tpu/ops/lpbound.py."""
+    from karpenter_tpu.ops.lpbound import cost_lower_bound as lb
+    return lb(prob)
 
 
 def run_config(name, pods, n_types, pools=None, iters=5):

@@ -52,10 +52,11 @@ def oracle_ffd(problem: Problem,
     nodes = []  # list of dict(option=..., used=np.ndarray, existing=bool)
     if E:
         class_ids = np.repeat(np.arange(problem.num_classes), problem.class_counts)
-        norm = problem.option_alloc.mean(axis=0)
-        norm = np.where(norm > 0, norm, 1.0)
-        size = (problem.class_requests[class_ids] / norm).sum(axis=1)
-        order = np.argsort(-size, kind="stable")
+        # derive the per-pod order from Problem.class_order (the single
+        # source of ordering truth) instead of re-implementing its size key
+        rank = np.empty(problem.num_classes)
+        rank[problem.class_order()] = np.arange(problem.num_classes)
+        order = np.argsort(rank[class_ids], kind="stable")
         ec = existing_compat if existing_compat is not None else \
             np.ones((problem.num_classes, E), bool)
         compat_exist = ec[class_ids][order]
