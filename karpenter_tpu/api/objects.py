@@ -79,6 +79,7 @@ class Pod:
     owner_kind: str = "ReplicaSet"       # "" == ownerless (blocks consolidation)
     node_name: str = ""                  # bound node ("" == pending)
     uid: str = field(default_factory=lambda: _uid("pod"))
+    created_at: float = field(default_factory=time.time)  # arrival (bind-latency input)
 
     DO_NOT_DISRUPT = "karpenter.sh/do-not-disrupt"
 

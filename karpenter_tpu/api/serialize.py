@@ -211,6 +211,81 @@ def nodeclass_from_manifest(m: Dict, validate: bool = True) -> NodeClass:
 
 
 # ---------------------------------------------------------------------------
+# NodeClaim (machine-created; serialized for status export / hydration dumps,
+# reference CRD pkg/apis/crds/karpenter.sh_nodeclaims.yaml)
+# ---------------------------------------------------------------------------
+
+def nodeclaim_to_manifest(claim: NodeClaim) -> Dict:
+    spec: Dict = {
+        "nodePoolRef": {"name": claim.nodepool},
+        "nodeClassRef": {"name": claim.node_class_ref},
+        "requirements": [requirement_to_dict(r)
+                         for r in claim.requirements.values()],
+        "taints": [taint_to_dict(t) for t in claim.taints],
+    }
+    if claim.requests:
+        spec["resources"] = {"requests": {k: format_quantity(v, k)
+                                          for k, v in claim.requests.items()}}
+    status: Dict = {}
+    if claim.node_class_hash:
+        spec["nodeClassHash"] = claim.node_class_hash
+    if claim.provider_id:
+        status["providerID"] = claim.provider_id
+        status.update({"instanceType": claim.instance_type,
+                       "zone": claim.zone,
+                       "capacityType": claim.capacity_type,
+                       "imageID": claim.image_id,
+                       "price": claim.price,
+                       "launchedAt": claim.launched_at})
+    conds = []
+    if claim.launched:
+        conds.append({"type": "Launched", "status": "True"})
+    if claim.registered:
+        conds.append({"type": "Registered", "status": "True"})
+    if claim.initialized:
+        conds.append({"type": "Initialized", "status": "True"})
+    if conds:
+        status["conditions"] = conds
+    out = {"apiVersion": f"{GROUP}/{VERSION}", "kind": "NodeClaim",
+           "metadata": {"name": claim.name,
+                        "labels": dict(claim.labels)},
+           "spec": spec}
+    if status:
+        out["status"] = status
+    return out
+
+
+def nodeclaim_from_manifest(m: Dict) -> NodeClaim:
+    spec = m.get("spec", {})
+    status = m.get("status", {})
+    claim = NodeClaim(
+        nodepool=spec.get("nodePoolRef", {}).get("name", ""),
+        node_class_ref=spec.get("nodeClassRef", {}).get("name", "default"),
+        requirements=Requirements.of(*[requirement_from_dict(r)
+                                       for r in spec.get("requirements", [])]),
+        requests=ResourceList.parse(
+            spec.get("resources", {}).get("requests", {}) or {}),
+        taints=[taint_from_dict(t) for t in spec.get("taints", [])],
+        labels=dict(m.get("metadata", {}).get("labels", {})),
+    )
+    if m.get("metadata", {}).get("name"):
+        claim.name = m["metadata"]["name"]
+    claim.node_class_hash = spec.get("nodeClassHash", "")
+    claim.provider_id = status.get("providerID", "")
+    claim.instance_type = status.get("instanceType", "")
+    claim.zone = status.get("zone", "")
+    claim.capacity_type = status.get("capacityType", "")
+    claim.image_id = status.get("imageID", "")
+    claim.price = float(status.get("price", 0.0))
+    claim.launched_at = float(status.get("launchedAt", 0.0))
+    conds = {c.get("type"): c.get("status") == "True"
+             for c in status.get("conditions", [])}
+    claim.registered = bool(conds.get("Registered"))
+    claim.initialized = bool(conds.get("Initialized"))
+    return claim
+
+
+# ---------------------------------------------------------------------------
 # CRD-schema generation (pkg/apis/crds analog)
 # ---------------------------------------------------------------------------
 
@@ -263,6 +338,51 @@ def crd_schemas() -> Dict[str, Dict]:
                         "requirements": {"type": "array",
                                          "items": requirement_schema},
                         "taints": {"type": "array", "items": taint_schema},
+                    },
+                },
+            },
+        },
+        "NodeClaim": {
+            "$schema": "https://json-schema.org/draft/2020-12/schema",
+            "title": f"NodeClaim.{GROUP}/{VERSION}",
+            "type": "object",
+            "required": ["spec"],
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "required": ["nodePoolRef"],
+                    "properties": {
+                        "nodePoolRef": {
+                            "type": "object",
+                            "required": ["name"],
+                            "properties": {"name": {"type": "string",
+                                                    "minLength": 1}},
+                        },
+                        "nodeClassRef": {
+                            "type": "object",
+                            "properties": {"name": {"type": "string"}},
+                        },
+                        "requirements": {"type": "array",
+                                         "items": requirement_schema},
+                        "taints": {"type": "array", "items": taint_schema},
+                        "resources": {
+                            "type": "object",
+                            "properties": {"requests": {"type": "object"}},
+                        },
+                        "nodeClassHash": {"type": "string"},
+                    },
+                },
+                "status": {
+                    "type": "object",
+                    "properties": {
+                        "providerID": {"type": "string"},
+                        "instanceType": {"type": "string"},
+                        "zone": {"type": "string"},
+                        "capacityType": {"enum": ["spot", "on-demand"]},
+                        "imageID": {"type": "string"},
+                        "price": {"type": "number", "minimum": 0},
+                        "launchedAt": {"type": "number", "minimum": 0},
+                        "conditions": {"type": "array"},
                     },
                 },
             },

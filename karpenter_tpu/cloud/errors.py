@@ -1,0 +1,103 @@
+"""Cloud error taxonomy: code classification, not string matching.
+
+Re-expresses the reference's error classifier
+(/root/reference/pkg/errors/errors.go:56-103): call sites ask *what kind*
+of failure they got — not-found, already-exists, unfulfillable capacity,
+launch-template-not-found — instead of comparing code strings inline.
+The code sets mirror the reference's lists; `CloudError` is the
+transport (cloud/fake.py), `InsufficientCapacityError` /
+`NodeClassNotFoundError` (cloud/provider.py) are the launch-path
+wrappers layered on top.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .fake import CloudError, ICE_CODE
+
+# errors.go:56-66 notFoundErrorCodes (+ the fake cloud's own spellings)
+NOT_FOUND_CODES = frozenset({
+    "InstanceNotFound",
+    "InvalidInstanceID.NotFound",
+    "InvalidLaunchTemplateId.NotFound",
+    "InvalidLaunchTemplateName.NotFoundException",
+    "ParameterNotFound",
+    "ImageNotFound",
+    "NoSuchEntity",
+    "ResourceNotFoundException",
+})
+
+# errors.go alreadyExistsErrorCodes
+ALREADY_EXISTS_CODES = frozenset({
+    "EntityAlreadyExists",
+    "AlreadyExists",
+    "InvalidLaunchTemplateName.AlreadyExistsException",
+})
+
+# errors.go:83-94 unfulfillableCapacityErrorCodes — fleet error codes that
+# mean "this offering cannot be fulfilled right now" and should feed the
+# ICE cache rather than fail the claim
+UNFULFILLABLE_CAPACITY_CODES = frozenset({
+    ICE_CODE,
+    "InsufficientInstanceCapacity",
+    "MaxSpotInstanceCountExceeded",
+    "VcpuLimitExceeded",
+    "UnfulfillableCapacity",
+    "Unsupported",
+    "InsufficientFreeAddressesInSubnet",
+})
+
+LAUNCH_TEMPLATE_NOT_FOUND_CODES = frozenset({
+    "InvalidLaunchTemplateId.NotFound",
+    "InvalidLaunchTemplateName.NotFoundException",
+})
+
+
+def _code(err: Optional[BaseException]) -> str:
+    return getattr(err, "code", "") or ""
+
+
+def is_not_found(err: Optional[BaseException]) -> bool:
+    """IsNotFound (errors.go:68-74): the named resource no longer exists —
+    for deletes this means success (idempotent terminate), for gets it
+    means the caller should treat the object as gone."""
+    c = _code(err)
+    return c in NOT_FOUND_CODES or c.endswith(".NotFound") \
+        or c.endswith("NotFoundException")
+
+
+def is_already_exists(err: Optional[BaseException]) -> bool:
+    """IsAlreadyExists: create raced with another creator — the resource is
+    there, proceed as if the create succeeded."""
+    c = _code(err)
+    return c in ALREADY_EXISTS_CODES or "AlreadyExists" in c
+
+
+def is_unfulfillable_capacity(err: Optional[BaseException]) -> bool:
+    """IsUnfulfillableCapacity (errors.go:96-103): feed the ICE cache and
+    retry other offerings instead of failing the claim."""
+    return _code(err) in UNFULFILLABLE_CAPACITY_CODES
+
+
+def is_launch_template_not_found(err: Optional[BaseException]) -> bool:
+    """IsLaunchTemplateNotFound: the cached template was deleted out from
+    under us — invalidate and recreate (instance.go:96-100 retry)."""
+    return _code(err) in LAUNCH_TEMPLATE_NOT_FOUND_CODES
+
+
+def classify(err) -> str:
+    """One-word classification for logs/metrics labels.  Duck-typed on the
+    `code` attribute so fleet per-override errors (cloud/fake.py FleetError)
+    classify the same way CloudError exceptions do."""
+    if not _code(err):
+        return "other"
+    if is_unfulfillable_capacity(err):
+        return "unfulfillable_capacity"
+    if is_launch_template_not_found(err):
+        return "launch_template_not_found"
+    if is_not_found(err):
+        return "not_found"
+    if is_already_exists(err):
+        return "already_exists"
+    return "cloud_error"

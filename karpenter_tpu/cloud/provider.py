@@ -321,8 +321,11 @@ class CloudProvider:
             settled.append(True)
         # feed partial failures back into the ICE cache
         # (instance.go:369-375 updateUnavailableOfferingsCache)
+        from .errors import classify, is_unfulfillable_capacity
+        err_counter = metrics.cloud_errors_total()
         for err in result.errors:
-            if err.code == ICE_CODE:
+            err_counter.inc({"classification": classify(err)})
+            if is_unfulfillable_capacity(err):
                 self.unavailable.mark_unavailable_for_fleet_err(
                     err.code, err.override.instance_type, err.override.zone,
                     err.override.capacity_type)

@@ -47,6 +47,7 @@ from ..ops.constraints import (LEVEL_REQUIRED_ONLY,
 from ..ops.ffd import PackingResult
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
+from ..utils import metrics
 
 log = logging.getLogger("karpenter_tpu.disruption")
 
@@ -318,35 +319,57 @@ class DisruptionController:
     # the single-action reconcile
     # ------------------------------------------------------------------
     def reconcile(self) -> DisruptionResult:
+        eval_hist = metrics.disruption_evaluation_duration()
+        eligible = metrics.disruption_eligible_nodes()
         cands = self.candidates()
+        # per-method eligibility gauges, all computed up-front so no series
+        # goes stale when an earlier method short-circuits the tick (calling
+        # find_empty every tick also keeps its empty-since timers fresh)
+        expired = self.find_expired(cands)
+        drifted = self.find_drifted(cands)
+        empty = self.find_empty(cands)
+        underutil = [c for c in cands
+                     if c.pool.disruption.consolidation_policy == "WhenUnderutilized"]
+        eligible.set(len(expired), {"method": "expiration"})
+        eligible.set(len(drifted), {"method": "drift"})
+        eligible.set(len(empty), {"method": "emptiness"})
+        eligible.set(len(underutil), {"method": "consolidation"})
         if not cands:
             return DisruptionResult()
 
+        def timed(method, fn):
+            t0 = time.perf_counter()
+            try:
+                return fn()
+            finally:
+                eval_hist.observe(time.perf_counter() - t0,
+                                  {"method": method})
+
         # 1. expiration (graceful replace: pods rescheduled, new capacity allowed)
-        expired = self.find_expired(cands)
         if expired:
-            action = self._replace_or_delete(expired[:1], "expiration")
+            action = timed("expiration",
+                           lambda: self._replace_or_delete(expired[:1],
+                                                           "expiration"))
             if action:
                 return self.execute(action)
 
         # 2. drift
-        drifted = self.find_drifted(cands)
         if drifted:
-            action = self._replace_or_delete(drifted[:1], "drift")
+            action = timed("drift",
+                           lambda: self._replace_or_delete(drifted[:1],
+                                                           "drift"))
             if action:
                 return self.execute(action)
 
         # 3. emptiness — all empty candidates in one shot (reference's
         #    emptiness batch delete)
-        empty = self.find_empty(cands)
         if empty:
             return self.execute(Action(kind="delete", reason="emptiness",
                                        candidates=empty))
 
         # 4. consolidation (WhenUnderutilized pools only)
-        underutil = [c for c in cands
-                     if c.pool.disruption.consolidation_policy == "WhenUnderutilized"]
-        action = self.consolidation_action(underutil)
+        action = timed("consolidation",
+                       lambda: self.consolidation_action(underutil))
         if action:
             return self.execute(action)
         return DisruptionResult()
@@ -506,6 +529,8 @@ class DisruptionController:
                 except InsufficientCapacityError as e:
                     # rollback: untaint, unmark, abandon the action
                     # (website/.../concepts/disruption.md:12-14)
+                    metrics.disruption_replacement_failures().inc(
+                        {"method": action.reason})
                     log.warning("disruption rollback, launch failed: %s", e)
                     self._rollback(action, new_nodes, out)
                     out.error = str(e)
@@ -548,7 +573,8 @@ class DisruptionController:
                     self.provider.delete(c.claim)
                     self.cluster.nodeclaims.pop(c.claim.name, None)
             except Exception as e:
-                already_gone = isinstance(e, CloudError) and e.code == "InstanceNotFound"
+                from ..cloud import errors as cloud_errors
+                already_gone = isinstance(e, CloudError) and cloud_errors.is_not_found(e)
                 if not already_gone:
                     # transient cloud failure (typed or not): untaint so the
                     # next reconcile retries this (now-empty) node instead of

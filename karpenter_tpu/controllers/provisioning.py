@@ -121,12 +121,36 @@ class Provisioner:
 
     def _pools_within_limits(self) -> List[NodePool]:
         usage = self.cluster.nodepool_usage()
+        # usage/limit gauges (reference karpenter_nodepool_usage / _limit
+        # families).  Series set last round but absent now (pool drained,
+        # resource gone) are deleted so /metrics never reports stale values.
+        usage_g, limit_g = metrics.nodepool_usage(), metrics.nodepool_limit()
+        prev_u = getattr(self, "_usage_gauge_keys", set())
+        prev_l = getattr(self, "_limit_gauge_keys", set())
+        cur_u, cur_l = set(), set()
+        # usage covers every pool with LIVE capacity — including pools
+        # removed from config mid-drain, which still hold launched resources
+        # (nodes_total keeps those series too; the two families must agree)
+        for pool_name in set(usage) | set(self.nodepools):
+            for res, qty in usage.get(pool_name, ResourceList()).items():
+                usage_g.set(qty, {"nodepool": pool_name, "resource_type": res})
+                cur_u.add((pool_name, res))
         out = []
         for pool in self.nodepools.values():
-            if pool.within_limits(usage.get(pool.name, ResourceList())):
+            pool_usage = usage.get(pool.name, ResourceList())
+            for res, qty in (pool.limits or {}).items():
+                limit_g.set(qty, {"nodepool": pool.name, "resource_type": res})
+                cur_l.add((pool.name, res))
+            if pool.within_limits(pool_usage):
                 out.append(pool)
             else:
                 log.info("nodepool %s at limit, excluded from provisioning", pool.name)
+        for pool_name, res in prev_u - cur_u:
+            usage_g.delete({"nodepool": pool_name, "resource_type": res})
+        for pool_name, res in prev_l - cur_l:
+            limit_g.delete({"nodepool": pool_name, "resource_type": res})
+        self._usage_gauge_keys = cur_u
+        self._limit_gauge_keys = cur_l
         return out
 
     def solve(self, pods: Sequence[Pod],
@@ -214,6 +238,18 @@ class Provisioner:
             out.failed_launches.extend(retry.failed_launches)
             out.stranded = retry.stranded
         metrics.pods_unschedulable().set(len(out.unschedulable))
+        counts: Dict[str, int] = {}
+        for node in self.cluster.nodes.values():
+            counts[node.nodepool] = counts.get(node.nodepool, 0) + 1
+        nodes_g = metrics.nodes_total()
+        # every known pool gets a sample (0 after draining — not a stale
+        # count); series for pools gone from BOTH config and cluster drop
+        cur = set(self.nodepools) | set(counts)
+        for pool_name in cur:
+            nodes_g.set(counts.get(pool_name, 0), {"nodepool": pool_name})
+        for pool_name in getattr(self, "_nodes_gauge_keys", set()) - cur:
+            nodes_g.delete({"nodepool": pool_name})
+        self._nodes_gauge_keys = cur
         return out
 
     def _provision_once(self, pods: Optional[Sequence[Pod]] = None) -> ProvisioningResult:

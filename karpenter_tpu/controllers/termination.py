@@ -123,7 +123,8 @@ class TerminationController:
             try:
                 self.provider.delete(claim)
             except CloudError as e:
-                if e.code != "InstanceNotFound":  # already gone == success
+                from ..cloud.errors import is_not_found
+                if not is_not_found(e):  # already gone == success
                     out.errors.append(f"{node.name}: {e}")
                     out.requeued.append(node.name)
                     return
@@ -145,3 +146,7 @@ class TerminationController:
         self.cluster.unbind_pod(pod)
         if not pod.owner_kind:
             self.cluster.pods.pop(pod.uid, None)
+        else:
+            # the replacement pod is a fresh arrival — without this, its
+            # re-bind would record the pod's whole lifetime as bind latency
+            pod.created_at = self.clock()

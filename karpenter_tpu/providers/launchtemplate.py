@@ -94,7 +94,8 @@ class LaunchTemplateProvider:
         try:
             self.cloud.create_launch_template(lt)
         except CloudError as e:
-            if "AlreadyExists" not in e.code:
+            from ..cloud.errors import is_already_exists
+            if not is_already_exists(e):   # create raced: template is there
                 raise
             lt = self.cloud.launch_templates[name]
         self._cache.set(name, lt)

@@ -25,6 +25,7 @@ from ..api.resources import DEFAULT_AXES, DEFAULT_SCALES, PODS, ResourceList
 from ..ops.constraints import pod_is_soft
 from ..ops.tensorize import _class_key
 from ..api.taints import tolerates_all
+from ..utils import metrics
 
 _names = itertools.count(1)
 
@@ -43,6 +44,7 @@ class Cluster:
 
     # ---- pods ----
     def add_pod(self, pod: Pod) -> Pod:
+        pod.created_at = self.clock()   # informer-arrival stamp (bind latency)
         self.pods[pod.uid] = pod
         # admission-time lowering: compute the pod's equivalence-class key
         # and softness flag here (the informer-decode analog), so the
@@ -62,6 +64,7 @@ class Cluster:
             node.pods = [p for p in node.pods if p.uid != pod.uid]
 
     def bind_pod(self, pod: Pod, node_name: str):
+        rebind = bool(pod.node_name)
         if pod.node_name and pod.node_name in self.nodes:
             old = self.nodes[pod.node_name]
             old.pods = [p for p in old.pods if p.uid != pod.uid]
@@ -69,6 +72,11 @@ class Cluster:
         node = self.nodes[node_name]
         node.pods.append(pod)
         node.nominated_until = 0.0  # nomination fulfilled
+        if not rebind:
+            # first bind only: arrival → placement latency
+            # (karpenter_pods_bound_duration_seconds)
+            metrics.pods_bound_duration().observe(
+                max(0.0, self.clock() - pod.created_at))
 
     def unbind_pod(self, pod: Pod):
         if pod.node_name and pod.node_name in self.nodes:

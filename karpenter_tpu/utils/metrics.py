@@ -294,3 +294,62 @@ def pods_unschedulable() -> Gauge:
     return REGISTRY.gauge(
         "karpenter_provisioner_pods_unschedulable",
         "Pods the last solve could not place.")
+
+
+def disruption_evaluation_duration() -> Histogram:
+    """Consolidation/disruption decision timing
+    (reference karpenter_disruption_evaluation_duration_seconds,
+    website/.../reference/metrics.md:30-195)."""
+    return REGISTRY.histogram(
+        "karpenter_disruption_evaluation_duration_seconds",
+        "Duration of one disruption reconcile evaluation.",
+        labels=("method",))
+
+
+def disruption_replacement_failures() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_disruption_replacement_nodeclaim_failures_total",
+        "Replacement launches that failed during disruption.",
+        labels=("method",))
+
+
+def disruption_eligible_nodes() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_disruption_eligible_nodes",
+        "Nodes eligible for disruption at last evaluation.",
+        labels=("method",))
+
+
+def nodepool_usage() -> Gauge:
+    """Per-pool resource usage (karpenter_nodepool_usage)."""
+    return REGISTRY.gauge(
+        "karpenter_nodepool_usage",
+        "Resources launched per nodepool.",
+        labels=("nodepool", "resource_type"))
+
+
+def nodepool_limit() -> Gauge:
+    """Per-pool resource limits (karpenter_nodepool_limit)."""
+    return REGISTRY.gauge(
+        "karpenter_nodepool_limit",
+        "Configured resource limits per nodepool.",
+        labels=("nodepool", "resource_type"))
+
+
+def nodes_total() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_nodes_total",
+        "Nodes managed, by pool.", labels=("nodepool",))
+
+
+def pods_bound_duration() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_pods_bound_duration_seconds",
+        "Time from pod arrival to binding.")
+
+
+def cloud_errors_total() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_cloudprovider_errors_total",
+        "Cloud API errors by classification.",
+        labels=("classification",))

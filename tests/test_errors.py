@@ -1,0 +1,75 @@
+"""Cloud error taxonomy tests (reference pkg/errors/errors.go:56-103)."""
+
+from karpenter_tpu.cloud.errors import (classify, is_already_exists,
+                                        is_launch_template_not_found,
+                                        is_not_found,
+                                        is_unfulfillable_capacity)
+from karpenter_tpu.cloud.fake import CloudError, FleetError, FleetOverride
+
+
+def test_not_found_codes():
+    assert is_not_found(CloudError("InstanceNotFound", "i-1"))
+    assert is_not_found(CloudError("InvalidLaunchTemplateId.NotFound", "x"))
+    assert is_not_found(CloudError("Something.NotFound", "x"))   # suffix rule
+    assert not is_not_found(CloudError("InternalError", "x"))
+    assert not is_not_found(None)
+
+
+def test_already_exists_codes():
+    assert is_already_exists(CloudError("EntityAlreadyExists", "p"))
+    assert is_already_exists(
+        CloudError("InvalidLaunchTemplateName.AlreadyExistsException", "t"))
+    assert not is_already_exists(CloudError("InstanceNotFound", "x"))
+
+
+def test_unfulfillable_capacity_codes():
+    assert is_unfulfillable_capacity(
+        CloudError("InsufficientInstanceCapacity", "pool"))
+    assert is_unfulfillable_capacity(CloudError("MaxSpotInstanceCountExceeded", ""))
+    assert not is_unfulfillable_capacity(CloudError("InternalError", ""))
+
+
+def test_launch_template_not_found_is_both():
+    e = CloudError("InvalidLaunchTemplateId.NotFound", "t")
+    assert is_launch_template_not_found(e)
+    assert is_not_found(e)
+
+
+def test_classify_covers_fleet_errors():
+    ov = FleetOverride("a.small", "zone-a", "spot", 0.1)
+    assert classify(FleetError(ov, "InsufficientInstanceCapacity")) == \
+        "unfulfillable_capacity"
+    assert classify(CloudError("InstanceNotFound", "i")) == "not_found"
+    assert classify(CloudError("EntityAlreadyExists", "p")) == "already_exists"
+    assert classify(CloudError("Weird", "x")) == "cloud_error"
+    assert classify(RuntimeError("boom")) == "other"
+
+
+def test_launch_path_classifies_ice_and_feeds_cache():
+    """Fleet ICE codes flow through the classifier into the unavailable
+    cache and the error-classification counter."""
+    from karpenter_tpu.api.objects import NodeClaim
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.cloud import CloudProvider, FakeCloud
+    from karpenter_tpu.utils import metrics
+    metrics.REGISTRY.reset()
+    cloud = FakeCloud()
+    catalog = generate_catalog(4)
+    # ICE the CHEAPEST offering so the fleet attempts it first, fails with
+    # an ICE code, and falls through to the next-cheapest type
+    cheapest_it, cheapest_o = min(
+        ((it, o) for it in catalog for o in it.offerings),
+        key=lambda pair: pair[1].price)
+    cloud.insufficient_capacity_pools.add(
+        (cheapest_o.capacity_type, cheapest_it.name, cheapest_o.zone))
+    provider = CloudProvider(cloud, catalog)
+    claim = provider.create(NodeClaim(nodepool="p"))
+    assert claim.provider_id                      # launch still succeeded
+    assert (claim.instance_type, claim.zone) != (cheapest_it.name,
+                                                 cheapest_o.zone)
+    # the failed offering was classified and fed into the ICE cache
+    c = metrics.cloud_errors_total()
+    classified = {key[0][1]: v for _, key, v in c.samples()}
+    assert classified.get("unfulfillable_capacity", 0) >= 1
+    assert provider.unavailable.is_unavailable(
+        cheapest_o.capacity_type, cheapest_it.name, cheapest_o.zone)

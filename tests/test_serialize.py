@@ -93,7 +93,7 @@ class TestNodeClassRoundtrip:
 
     def test_schemas_validate_shapes(self):
         schemas = crd_schemas()
-        assert set(schemas) == {"NodePool", "NodeClass"}
+        assert set(schemas) == {"NodePool", "NodeClass", "NodeClaim"}
         # sanity: generated manifests carry the right top-level keys
         m = nodepool_to_manifest(NodePool())
         assert set(schemas["NodePool"]["required"]) <= set(m)
@@ -210,3 +210,53 @@ class TestDeserializationAdmission:
         with pytest.raises(ValidationError):
             nodeclass_from_manifest(bad)
         assert nodeclass_from_manifest(bad, validate=False).image_family == "custom"
+
+
+class TestNodeClaimSerialize:
+    def test_roundtrip(self):
+        from karpenter_tpu.api.objects import NodeClaim
+        from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+        from karpenter_tpu.api.serialize import (nodeclaim_from_manifest,
+                                                 nodeclaim_to_manifest)
+        from karpenter_tpu.api.taints import Taint
+        claim = NodeClaim(
+            nodepool="team-a", node_class_ref="gpu",
+            requirements=Requirements.of(
+                Requirement("kubernetes.io/arch", IN, ["amd64"])),
+            requests=ResourceList.parse({"cpu": "2", "memory": "4Gi"}),
+            taints=[Taint("dedicated", "NoSchedule", "ml")],
+            labels={"team": "a"})
+        claim.provider_id = "i-123"
+        claim.instance_type = "a.large"
+        claim.zone = "zone-b"
+        claim.capacity_type = "spot"
+        claim.image_id = "img-7"
+        claim.price = 0.42
+        claim.launched_at = 1234.5
+        claim.node_class_hash = "abc123"
+        claim.registered = True
+        m = nodeclaim_to_manifest(claim)
+        assert m["kind"] == "NodeClaim"
+        back = nodeclaim_from_manifest(m)
+        assert back.nodepool == "team-a"
+        assert back.node_class_ref == "gpu"
+        assert back.requests == claim.requests
+        assert back.provider_id == "i-123"
+        assert back.image_id == "img-7"
+        assert back.capacity_type == "spot"
+        assert back.node_class_hash == "abc123"   # drift input must survive
+        assert back.launched_at == 1234.5
+        assert back.registered and not back.initialized
+        assert [t.key for t in back.taints] == ["dedicated"]
+
+    def test_schema_validates_manifest(self):
+        import jsonschema
+        from karpenter_tpu.api.objects import NodeClaim
+        from karpenter_tpu.api.serialize import (crd_schemas,
+                                                 nodeclaim_to_manifest)
+        schema = crd_schemas()["NodeClaim"]
+        m = nodeclaim_to_manifest(NodeClaim(nodepool="p"))
+        jsonschema.Draft202012Validator(schema).validate(m)
+        bad = {"kind": "NodeClaim", "spec": {}}   # missing nodePoolRef
+        errs = list(jsonschema.Draft202012Validator(schema).iter_errors(bad))
+        assert errs
