@@ -183,3 +183,51 @@ def test_combined_disruption_methods():
     now = clock[0]
     for n in cluster.nodes.values():
         assert now - n.created_at < 300  # every survivor is a fresh node
+
+
+@pytest.mark.scale
+def test_full_loop_reference_scale_provision_disrupt_terminate():
+    """The reference's pod-dense shape (60 nodes × 110 pods = 6,600 pods,
+    provisioning_test.go:113-145) driven through the FULL controller loop:
+    provision → workload shrinks → consolidation disrupts through the
+    finalizer-drain termination flow → fleet shrinks, every surviving pod
+    still bound.  Wall-time budgeted (the reference allows 30m on real
+    clusters; in-process must be minutes at most)."""
+    from karpenter_tpu.controllers import TerminationController
+    clock = [1000.0]
+    cloud, provider, cluster, prov, pools = env(clock=lambda: clock[0])
+    t_start = time.perf_counter()
+
+    # phase 1: provision 6,600 pods (110/node dense shape)
+    cluster.add_pods([cpu_pod(cpu_m=50, mem_mib=64) for _ in range(6600)])
+    res = prov.provision()
+    assert not res.unschedulable
+    assert res.scheduled == 6600
+    n_initial = len(cluster.nodes)
+    assert n_initial <= 70
+    assert len(cloud.running()) == n_initial
+
+    # phase 2: workload shrinks 80% → consolidation + termination drain the
+    # surplus through the finalizer flow
+    doomed = list(cluster.pods.values())[:5280]
+    for p in doomed:
+        cluster.delete_pod(p)
+    clock[0] += 600                      # stabilization lapses
+    term = TerminationController(provider, cluster, clock=lambda: clock[0])
+    ctrl = DisruptionController(provider, cluster, pools,
+                                clock=lambda: clock[0], terminator=term)
+    drain_disruption(ctrl, max_rounds=120, clock=clock)
+
+    # end state: fleet sized for the survivors, every pod bound, cloud and
+    # cluster state consistent
+    bound = sum(len(n.pods) for n in cluster.nodes.values())
+    assert bound == 1320
+    assert not cluster.pending_pods()
+    assert len(cluster.nodes) <= max(2, n_initial * 0.4)
+    assert len(cloud.running()) == len(cluster.nodes)
+    # no leaked finalizers/taints on survivors
+    from karpenter_tpu.controllers.disruption import DISRUPTION_TAINT
+    for n in cluster.nodes.values():
+        assert DISRUPTION_TAINT not in n.taints
+        assert not n.marked_for_deletion
+    assert time.perf_counter() - t_start < 300
