@@ -198,8 +198,12 @@ def class_pack_assign_kernel(requests, counts, compat_packed, node_cap,
     seconds over a tunneled link. Instead the per-pod slot is derived here:
     within a class, pod #r lands in the first slot where the class's
     inclusive take-cumsum exceeds r; flattening the cumsum over (class, slot)
-    keeps it one global searchsorted. Only O(P + K) ints leave the device."""
-    slot_option, slot_used, n_open, n_unsched, takes = class_pack_kernel_packed(
+    keeps it one global searchsorted. Only O(P + K) ints leave the device —
+    the tunnel moves ~7MB/s, so every byte of result payload is latency:
+    the assignment ships as int16 when K allows (slot ids < 2^15) and
+    per-slot resource usage is NOT returned at all (the host reconstructs
+    it from the assignment with one reduceat — saves a K×R transfer)."""
+    slot_option, _slot_used, n_open, n_unsched, takes = class_pack_kernel_packed(
         requests, counts, compat_packed, node_cap, alloc, price, rank,
         init_option, init_used, max_nodes, True)
     C = counts.shape[0]
@@ -219,7 +223,24 @@ def class_pack_assign_kernel(requests, counts, compat_packed, node_cap,
     slot = f - class_ids * K
     sched = rank_in_class < totals[class_ids]
     assignment = jnp.where(sched, slot, -1)
-    return assignment, slot_option, slot_used, n_unsched
+    if K < 2**15:
+        assignment = assignment.astype(jnp.int16)
+    return assignment, slot_option, n_unsched
+
+
+@partial(jax.jit, static_argnames=("max_nodes", "n_pods"))
+def class_pack_assign_kernel_fresh(requests, counts, compat_packed,
+                                   node_cap, alloc, price, rank,
+                                   max_nodes: int, n_pods: int):
+    """Assign kernel with NO pre-opened slots: the all-closed init state
+    (K ints + K×R zeros ≈ 260KB at 50k pods) materializes on device
+    instead of riding the ~7MB/s tunnel every fresh solve."""
+    R = alloc.shape[1]
+    init_option = jnp.full((max_nodes,), -1, jnp.int32)
+    init_used = jnp.zeros((max_nodes, R), jnp.int32)
+    return class_pack_assign_kernel(requests, counts, compat_packed,
+                                    node_cap, alloc, price, rank,
+                                    init_option, init_used, max_nodes, n_pods)
 
 
 @partial(jax.jit, static_argnames=("max_nodes",))
@@ -372,10 +393,14 @@ def solve_classpack(problem: Problem,
                              existing_assignments={}, total_price=total)
 
     Ppad = pad_to(P)
-    assignment, slot_option, slot_used, n_unsched = jax.device_get(
-        class_pack_assign_kernel(*pod_args, d_alloc, d_price, d_rank,
-                                 *init_args(), K, Ppad))
-    assignment = np.asarray(assignment)[:P]
+    if E == 0:
+        out = class_pack_assign_kernel_fresh(*pod_args, d_alloc, d_price,
+                                             d_rank, K, Ppad)
+    else:
+        out = class_pack_assign_kernel(*pod_args, d_alloc, d_price, d_rank,
+                                       *init_args(), K, Ppad)
+    assignment, slot_option, n_unsched = jax.device_get(out)
+    assignment = np.asarray(assignment, dtype=np.int32)[:P]
 
     new_mask = (slot_option >= 0) & (slot_option < O)
     total = float(problem.option_price[slot_option[new_mask]].sum())
@@ -404,6 +429,16 @@ def solve_classpack(problem: Problem,
     ends = np.append(starts[1:], len(ks))
     node_slots = ks[starts] if len(starts) else np.zeros(0, np.int32)
 
+    # per-node resource usage, reconstructed host-side (the kernel no longer
+    # ships its K×R slot_used — one gather + reduceat replaces a 200KB+
+    # tunnel transfer); values are exact: same integer sums the kernel's
+    # alloc-minus-free bookkeeping produces
+    if len(starts):
+        row_reqs = problem.class_requests[class_of_row[new_rows]]
+        node_used = np.add.reduceat(row_reqs, starts, axis=0).astype(np.int64)
+    else:
+        node_used = np.zeros((0, problem.class_requests.shape[1]), np.int64)
+
     # one global unique over (slot, class) pairs replaces a per-node
     # np.unique; searchsorted then yields every node's class-set span
     Cn = problem.num_classes
@@ -418,7 +453,7 @@ def solve_classpack(problem: Problem,
     # order of magnitude cheaper than per-element numpy scalar access
     pod_sorted = pod_idx[new_rows].tolist()
     oi_l = slot_option[node_slots].tolist()
-    used_l = slot_used[node_slots].tolist()
+    used_l = node_used.tolist()
     starts_l, ends_l = starts.tolist(), ends.tolist()
     cs_l, ce_l = cls_starts.tolist(), cls_ends.tolist()
     ucls_l = ucls.tolist()
@@ -449,7 +484,7 @@ def solve_classpack(problem: Problem,
         if hit is None:
             # jointly compatible with every class on the node, big enough
             # for its total usage, and from the same pool
-            used_vec = np.asarray(used_l[i], dtype=slot_used.dtype)
+            used_vec = np.asarray(used_l[i], dtype=np.int64)
             if len(cls) == 1:
                 jc = problem.class_compat[cls[0]]
             else:
