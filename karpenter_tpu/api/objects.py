@@ -160,6 +160,8 @@ class KubeletConfiguration:
     kube_reserved: ResourceList = field(default_factory=ResourceList)
     system_reserved: ResourceList = field(default_factory=ResourceList)
     eviction_hard: ResourceList = field(default_factory=ResourceList)
+    cluster_dns: str = ""   # pins the node's DNS resolver (v4 or v6);
+                            # "" == use the cluster's discovered kube-dns
 
 
 @dataclass

@@ -119,10 +119,15 @@ class FakeControlPlane(_Service):
     """Cluster control-plane endpoint (/root/reference/pkg/fake/eksapi.go +
     the kube version the version provider caches)."""
 
-    def __init__(self, version: str = "1.28", endpoint: str = "https://cluster.local"):
+    def __init__(self, version: str = "1.28", endpoint: str = "https://cluster.local",
+                 kube_dns_ip: str = "10.100.0.10"):
         super().__init__()
         self.version = version
         self.endpoint = endpoint
+        # the kube-dns service address: IPv4 by default; an IPv6 (single-
+        # stack) cluster publishes an IPv6 service IP here (the reference
+        # discovers it from the kube-dns Service, operator.go:248-261)
+        self.kube_dns_ip = kube_dns_ip
 
     def server_version(self) -> str:
         with self._lock:
@@ -135,3 +140,9 @@ class FakeControlPlane(_Service):
             self._count("describe_cluster")
             self._maybe_raise()
             return {"endpoint": self.endpoint, "version": self.version}
+
+    def kube_dns(self) -> str:
+        with self._lock:
+            self._count("kube_dns")
+            self._maybe_raise()
+            return self.kube_dns_ip

@@ -83,6 +83,13 @@ class Operator:
         if not self.options.cluster_endpoint:
             self.options.cluster_endpoint = \
                 self.control_plane.describe_cluster()["endpoint"]
+        # kube-dns discovery (kubeDNSIP operator.go:248-261): IPv6 clusters
+        # publish a v6 service IP and nodes bootstrap with it unchanged
+        if not self.options.cluster_dns:
+            try:
+                self.options.cluster_dns = self.control_plane.kube_dns()
+            except CloudError:
+                pass  # optional: bootstrap falls back to platform default
 
         self.recorder = Recorder(clock=clock)
         self.unavailable = UnavailableOfferings(clock=clock)
@@ -93,7 +100,8 @@ class Operator:
         self.version = VersionProvider(self.control_plane, clock=clock)
         self.images = ImageProvider(self.cloud, self.params, self.version)
         self.resolver = Resolver(self.images, self.options.cluster_name,
-                                 self.options.cluster_endpoint)
+                                 self.options.cluster_endpoint,
+                                 cluster_dns=self.options.cluster_dns)
         self.launch_templates = LaunchTemplateProvider(
             self.cloud, self.resolver, self.options.cluster_name, clock=clock)
         self.launch_templates.hydrate_cache()  # launchtemplate.go:336

@@ -35,6 +35,7 @@ def _parse_kv_list(raw: str, into: Dict, cast=lambda v: v) -> None:
 class Options:
     cluster_name: str = "default"
     cluster_endpoint: str = "https://cluster.local"
+    cluster_dns: str = ""                # empty == discover from control plane
     isolated_network: bool = False       # isolated-vpc analog: no pricing API
     vm_memory_overhead_percent: float = DEFAULT_VM_MEMORY_OVERHEAD
     interruption_queue: str = ""         # empty == interruption disabled
@@ -58,6 +59,8 @@ class Options:
                        default=env.get("cluster_name", "default"))
         p.add_argument("--cluster-endpoint",
                        default=env.get("cluster_endpoint", "https://cluster.local"))
+        p.add_argument("--cluster-dns",
+                       default=env.get("cluster_dns", ""))
         p.add_argument("--isolated-network", action="store_true",
                        default=env.get("isolated_network", False))
         p.add_argument("--vm-memory-overhead-percent", type=float,
@@ -85,6 +88,7 @@ class Options:
         opts = cls(
             cluster_name=ns.cluster_name,
             cluster_endpoint=ns.cluster_endpoint,
+            cluster_dns=ns.cluster_dns,
             isolated_network=ns.isolated_network,
             vm_memory_overhead_percent=ns.vm_memory_overhead_percent,
             interruption_queue=ns.interruption_queue,
@@ -132,6 +136,7 @@ class Options:
         mapping = {
             "cluster-name": ("cluster_name", str),
             "cluster-endpoint": ("cluster_endpoint", str),
+            "cluster-dns": ("cluster_dns", str),
             "isolated-network": ("isolated_network",
                                  lambda v: v.lower() == "true"),
             "vm-memory-overhead-percent": ("vm_memory_overhead_percent", float),

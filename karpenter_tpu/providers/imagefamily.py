@@ -54,9 +54,19 @@ class LaunchSpec:
 # Bootstrap userdata generation (bootstrap/ package analog)
 # ---------------------------------------------------------------------------
 
+def _resolve_dns(kubelet: Optional[KubeletConfiguration],
+                 cluster_dns: str) -> str:
+    """Pool kubelet config wins; else the cluster's discovered kube-dns IP
+    (v4 or v6 — IPv6 clusters bootstrap with their v6 service address).
+    The ONE copy of the precedence rule for every userdata family."""
+    if kubelet is not None and kubelet.cluster_dns:
+        return kubelet.cluster_dns
+    return cluster_dns
+
+
 def _bootstrap_script(cluster_name: str, endpoint: str, labels: Dict[str, str],
-                      taints: Sequence, kubelet: Optional[KubeletConfiguration],
-                      max_pods: Optional[int]) -> str:
+                      taints: Sequence, max_pods: Optional[int],
+                      dns: str = "") -> str:
     """The family's node-join script (eksbootstrap.go bootstrap flags)."""
     args = [f"--cluster {cluster_name}", f"--endpoint {endpoint}"]
     if labels:
@@ -67,8 +77,8 @@ def _bootstrap_script(cluster_name: str, endpoint: str, labels: Dict[str, str],
         args.append(f"--register-with-taints {ts}")
     if max_pods is not None:
         args.append(f"--max-pods {max_pods}")
-    if kubelet is not None and kubelet.cluster_dns:
-        args.append(f"--cluster-dns {kubelet.cluster_dns}")
+    if dns:
+        args.append(f"--cluster-dns {dns}")
     joined = " \\\n  ".join(args)
     return f"#!/bin/bash\nset -euo pipefail\n/opt/node/bootstrap.sh \\\n  {joined}\n"
 
@@ -114,7 +124,8 @@ def merge_config(custom: str, settings: Dict[str, str]) -> str:
 def generate_user_data(family: str, cluster_name: str, endpoint: str,
                        custom: str = "", labels: Optional[Dict[str, str]] = None,
                        taints: Sequence = (), kubelet=None,
-                       max_pods: Optional[int] = None) -> str:
+                       max_pods: Optional[int] = None,
+                       cluster_dns: str = "") -> str:
     if family == "custom":
         return custom  # verbatim; operator owns the whole bootstrap (custom.go)
     if family == "config":
@@ -125,9 +136,12 @@ def generate_user_data(family: str, cluster_name: str, endpoint: str,
             settings[f"node.taints.{t.key}"] = f"{t.value}:{t.effect}"
         if max_pods is not None:
             settings["node.max-pods"] = str(max_pods)
+        dns = _resolve_dns(kubelet, cluster_dns)
+        if dns:
+            settings["node.cluster-dns-ip"] = dns
         return merge_config(custom, settings)
     script = _bootstrap_script(cluster_name, endpoint, labels or {}, taints,
-                               kubelet, max_pods)
+                               max_pods, _resolve_dns(kubelet, cluster_dns))
     return merge_mime(custom, script)
 
 
@@ -213,10 +227,14 @@ class Resolver:
     LaunchSpecs grouped by image."""
 
     def __init__(self, image_provider: ImageProvider, cluster_name: str,
-                 endpoint: str):
+                 endpoint: str, cluster_dns: str = ""):
         self.image_provider = image_provider
         self.cluster_name = cluster_name
         self.endpoint = endpoint
+        # discovered kube-dns service IP (v4 or v6) — the bootstrap default
+        # when a pool's kubelet config doesn't pin its own cluster-dns
+        # (reference kubeDNSIP discovery, operator.go:248-261)
+        self.cluster_dns = cluster_dns
 
     def resolve(self, nodeclass: NodeClass, instance_types: Sequence[InstanceType],
                 labels: Optional[Dict[str, str]] = None, taints: Sequence = (),
@@ -234,7 +252,8 @@ class Resolver:
             user_data = generate_user_data(
                 nodeclass.image_family, self.cluster_name, self.endpoint,
                 custom=nodeclass.user_data, labels=labels, taints=taints,
-                kubelet=kubelet, max_pods=max_pods)
+                kubelet=kubelet, max_pods=max_pods,
+                cluster_dns=self.cluster_dns)
             specs.append(LaunchSpec(
                 image=img_index[image_id], user_data=user_data,
                 instance_types=its, security_group_ids=security_group_ids,

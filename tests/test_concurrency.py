@@ -1,0 +1,165 @@
+"""Concurrency stress suite — the race-detection analog of the reference's
+`go test -race` + shuffled-order runs (SURVEY §5.2; /root/reference/Makefile:68-74).
+
+Python has no race detector, so these tests hammer the components that are
+DOCUMENTED thread-safe (the batcher, TTL/ICE caches, metrics registry,
+event recorder) from many threads and assert end-state invariants: no lost
+results, no double-counting, monotone sequence numbers.  Controllers and
+cluster state are singleton-loop by design (operator/manager.py) and are
+deliberately out of scope."""
+
+import random
+import threading
+import time
+
+from karpenter_tpu.cloud.batcher import Batcher, Options
+from karpenter_tpu.cloud.cache import TTLCache, UnavailableOfferings
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.events import Recorder
+
+N_THREADS = 16
+
+
+def hammer(fn, n_threads=N_THREADS, iters=50):
+    """Run fn(thread_idx, iter_idx) from n_threads threads; re-raise the
+    first failure."""
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def run(t):
+        try:
+            barrier.wait(timeout=10)
+            for i in range(iters):
+                fn(t, i)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(t,)) for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not any(th.is_alive() for th in threads), "stress thread hung"
+    if errors:
+        raise errors[0]
+
+
+class TestBatcherConcurrency:
+    def test_no_request_lost_under_contention(self):
+        """Every add() gets exactly its own result back even when many
+        threads race into overlapping windows across several buckets."""
+        seen = []
+        lock = threading.Lock()
+
+        def executor(reqs):
+            time.sleep(random.random() * 0.01)  # jitter the window close
+            with lock:
+                seen.extend(reqs)
+            return [r * 10 for r in reqs]
+
+        b = Batcher(Options(name="stress", idle_timeout=0.005,
+                            max_timeout=0.05, max_items=32,
+                            request_hasher=lambda r: r % 3,
+                            batch_executor=executor))
+
+        def one(t, i):
+            v = t * 1000 + i
+            assert b.add(v) == v * 10
+
+        hammer(one)
+        assert sorted(seen) == sorted(t * 1000 + i
+                                      for t in range(N_THREADS)
+                                      for i in range(50))
+        assert b.stats.requests == N_THREADS * 50
+
+    def test_executor_failure_reaches_every_caller(self):
+        def executor(reqs):
+            raise RuntimeError("backend down")
+
+        b = Batcher(Options(name="fail", idle_timeout=0.001,
+                            max_timeout=0.01, max_items=8,
+                            request_hasher=lambda r: "all",
+                            batch_executor=executor))
+        failures = []
+        lock = threading.Lock()
+
+        def one(t, i):
+            try:
+                b.add(i)
+            except RuntimeError:
+                with lock:
+                    failures.append(1)
+
+        hammer(one, iters=10)
+        assert len(failures) == N_THREADS * 10
+
+
+class TestCacheConcurrency:
+    def test_ttl_cache_mixed_ops(self):
+        c = TTLCache(0.05)
+
+        def one(t, i):
+            k = f"k{i % 7}"
+            c.set(k, t)
+            c.get(k)
+            if i % 5 == 0:
+                c.delete(k)
+            if i % 11 == 0:
+                c.purge_expired()
+
+        hammer(one)
+
+    def test_unavailable_offerings_seq_monotone(self):
+        u = UnavailableOfferings(ttl=0.02)
+        seqs = [[] for _ in range(N_THREADS)]
+        lock = threading.Lock()
+
+        def one(t, i):
+            u.mark_unavailable("test", f"type-{i % 5}", f"zone-{t % 3}", "spot")
+            u.is_unavailable("spot", f"type-{i % 5}", f"zone-{t % 3}")
+            with lock:
+                seqs[t].append(u.seq_num)
+            if i % 10 == 0:
+                time.sleep(0.005)  # let entries expire mid-stream
+
+        hammer(one)
+        # each thread's observation stream must be non-decreasing — a seq
+        # that regresses would serve stale memoized catalogs as fresh
+        for stream in seqs:
+            assert stream == sorted(stream), "seq_num regressed"
+        assert u.seq_num >= max(s[-1] for s in seqs)
+
+
+class TestMetricsConcurrency:
+    def test_counter_histogram_totals_exact(self):
+        metrics.REGISTRY.reset()
+        c = metrics.REGISTRY.counter("stress_total", labels=("t",))
+        h = metrics.REGISTRY.histogram("stress_obs")
+
+        def one(t, i):
+            c.inc({"t": str(t % 4)})
+            h.observe(0.5)
+
+        hammer(one)
+        total = sum(v for _, _, v in c.samples())
+        assert total == N_THREADS * 50
+        assert h.count() == N_THREADS * 50
+        metrics.REGISTRY.expose()  # rendering under load doesn't blow up
+
+    def test_recorder_dedupe_under_contention(self):
+        from karpenter_tpu.utils.events import Event
+        rec = Recorder(dedupe_window=1000.0, log=False)
+        accepted = []
+        lock = threading.Lock()
+
+        def one(t, i):
+            ev = Event(kind="Node", name="node-1", reason="Launched",
+                       message="same message")
+            if rec.publish(ev):
+                with lock:
+                    accepted.append(1)
+
+        hammer(one)
+        # all threads raced the same event: exactly one clears the window
+        assert len(accepted) == 1
+        assert len(rec.events()) == 1

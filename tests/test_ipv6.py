@@ -1,0 +1,69 @@
+"""IPv6 cluster suite analog (/root/reference/test/suites/ipv6/suite_test.go):
+nodes provisioned in a single-stack IPv6 cluster bootstrap against the
+cluster's IPv6 kube-dns service IP — discovered from the control plane, or
+pinned per-pool through kubelet config."""
+
+from karpenter_tpu.api.objects import KubeletConfiguration, NodeClass, Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import ImageInfo
+from karpenter_tpu.cloud.services import FakeControlPlane, FakeParameterStore
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers.imagefamily import (ImageProvider, Resolver,
+                                                 generate_user_data)
+from karpenter_tpu.providers.version import VersionProvider
+
+IPV6_DNS = "fd4e:9fbe:cd6a::a"
+
+
+def _operator(**cp_kw):
+    cp = FakeControlPlane(**cp_kw)
+    op = Operator(Options(), catalog=generate_catalog(8), control_plane=cp)
+    op.cloud.images = [ImageInfo("img-1", "std", "amd64", 100.0)]
+    op.params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    return op
+
+
+def test_ipv6_kube_dns_discovered_from_control_plane():
+    op = _operator(kube_dns_ip=IPV6_DNS)
+    assert op.options.cluster_dns == IPV6_DNS
+    assert op.resolver.cluster_dns == IPV6_DNS
+
+
+def test_ipv6_node_bootstraps_with_v6_cluster_dns():
+    """Provision through the operator stack in an IPv6 cluster: the launch
+    template userdata carries the v6 kube-dns address (the suite's 'node
+    gets exactly one internal IPv6 address' end-state maps to the bootstrap
+    wiring here — the fake kubelet has no address object)."""
+    op = _operator(kube_dns_ip=IPV6_DNS)
+    specs = op.resolver.resolve(op.node_classes["default"],
+                                op.catalog[:4])
+    assert len(specs) == 1
+    assert f"--cluster-dns {IPV6_DNS}" in specs[0].user_data
+
+
+def test_pool_kubelet_cluster_dns_overrides_discovery():
+    """kubeletConfig clusterDNS wins over the discovered address
+    (suite_test.go:78-89 'discovering kubeletConfig kube-dns IP')."""
+    op = _operator(kube_dns_ip=IPV6_DNS)
+    pinned = "fd11:2233::53"
+    specs = op.resolver.resolve(
+        op.node_classes["default"], op.catalog[:4],
+        kubelet=KubeletConfiguration(cluster_dns=pinned))
+    assert f"--cluster-dns {pinned}" in specs[0].user_data
+    assert IPV6_DNS not in specs[0].user_data
+
+
+def test_ipv4_default_unchanged():
+    op = _operator()     # default v4 service IP
+    assert op.options.cluster_dns == "10.100.0.10"
+    specs = op.resolver.resolve(op.node_classes["default"], op.catalog[:4])
+    assert "--cluster-dns 10.100.0.10" in specs[0].user_data
+
+
+def test_config_family_carries_dns_setting():
+    out = generate_user_data("config", "kc", "https://ep",
+                             cluster_dns=IPV6_DNS)
+    assert f'node.cluster-dns-ip = "{IPV6_DNS}"' in out

@@ -415,3 +415,32 @@ class TestApply:
         with pytest.raises(ValidationError):
             op.apply(hijack)
         assert op.node_classes["web"].role == "r1"
+
+
+class TestKompat:
+    """tools/kompat.py — the compatibility-matrix CLI analog."""
+
+    def _write_matrix(self, tmp_path):
+        f = tmp_path / "compat.yaml"
+        f.write_text(
+            "compatibility:\n"
+            "  - {appVersion: 0.30.0, minK8sVersion: '1.23', maxK8sVersion: '1.27'}\n"
+            "  - {appVersion: 0.31.0, minK8sVersion: '1.24', maxK8sVersion: '1.28'}\n")
+        return str(f)
+
+    def test_check_and_table(self, tmp_path, capsys):
+        import importlib.util
+        import pathlib
+        spec = importlib.util.spec_from_file_location(
+            "kompat", pathlib.Path(__file__).resolve().parents[1]
+            / "tools" / "kompat.py")
+        kompat = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(kompat)
+        path = self._write_matrix(tmp_path)
+        assert kompat.main([path, "--check", "--app-version", "0.31.0",
+                            "--k8s-version", "1.28"]) == 0
+        assert kompat.main([path, "--check", "--app-version", "0.30.0",
+                            "--k8s-version", "1.28"]) == 1
+        assert kompat.main([path, "-n", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "0.31.0" in out and "1.24 - 1.28" in out

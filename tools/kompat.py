@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""kompat: query a Kubernetes compatibility matrix file.
+
+Analog of the reference's tools/kompat (tools/kompat/pkg/kompat/kompat.go):
+a `compatibility.yaml` lists app versions with the min/max Kubernetes
+control-plane versions each supports; this tool prints the matrix as a
+markdown table, filters to the last N app versions, and answers "is app
+version X compatible with K8s version Y" with a non-zero exit on
+incompatibility.
+
+Usage:
+    python tools/kompat.py deploy/compatibility.yaml
+    python tools/kompat.py deploy/compatibility.yaml -n 3
+    python tools/kompat.py deploy/compatibility.yaml \
+        --check --app-version 0.32.1 --k8s-version 1.28
+"""
+
+import argparse
+import sys
+from typing import Dict, List, Tuple
+
+import yaml
+
+
+def _minor_range(lo: str, hi: str) -> List[str]:
+    """Expand "1.23".."1.28" into every minor version in between."""
+    lo_maj, lo_min = (int(x) for x in lo.split(".")[:2])
+    hi_maj, hi_min = (int(x) for x in hi.split(".")[:2])
+    if lo_maj != hi_maj:
+        raise ValueError(f"major version ranges unsupported: {lo}..{hi}")
+    return [f"{lo_maj}.{m}" for m in range(lo_min, hi_min + 1)]
+
+
+def _version_str(v) -> str:
+    """Normalize a YAML version scalar: unquoted `1.30` parses as the float
+    1.3, which would silently corrupt the range — reject non-strings."""
+    if not isinstance(v, str):
+        raise ValueError(
+            f"version {v!r} must be a quoted string in the YAML "
+            f"(unquoted numbers lose trailing zeros: 1.30 -> 1.3)")
+    return v
+
+
+def load(path: str) -> List[Dict]:
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    if isinstance(doc, list):
+        entries = doc
+    else:
+        entries = doc.get("compatibility", [])
+    if not entries:
+        raise ValueError(f"{path}: no compatibility entries")
+    for e in entries:
+        for key in ("appVersion", "minK8sVersion", "maxK8sVersion"):
+            e[key] = _version_str(e[key])
+    return entries
+
+
+def expand(entries: List[Dict]) -> Dict[str, List[str]]:
+    """k8s minor version → app versions supporting it (kompat.go expand)."""
+    out: Dict[str, List[str]] = {}
+    for e in entries:
+        for k8s in _minor_range(e["minK8sVersion"], e["maxK8sVersion"]):
+            out.setdefault(k8s, []).append(e["appVersion"])
+    return out
+
+
+def is_compatible(entries: List[Dict], app_version: str,
+                  k8s_version: str) -> Tuple[bool, str]:
+    k8s_minor = ".".join(k8s_version.split(".")[:2])
+    matrix = expand(entries)
+    if k8s_minor not in matrix:
+        return False, (f"K8s version {k8s_version} is outside every "
+                       f"documented compatibility range")
+    if app_version not in matrix[k8s_minor]:
+        return False, (f"app version {app_version} is not compatible with "
+                       f"K8s version {k8s_version} "
+                       f"(compatible: {', '.join(matrix[k8s_minor])})")
+    return True, f"{app_version} is compatible with K8s {k8s_version}"
+
+
+def markdown_table(entries: List[Dict], last_n: int = 0) -> str:
+    rows = entries[-last_n:] if last_n else entries
+    head = ["App Version"] + [str(r["appVersion"]) for r in rows]
+    k8s = ["K8s Versions"] + [
+        f'{r["minK8sVersion"]} - {r["maxK8sVersion"]}' for r in rows]
+    widths = [max(len(a), len(b)) for a, b in zip(head, k8s)]
+    fmt = "| " + " | ".join(f"{{:<{w}}}" for w in widths) + " |"
+    sep = "|" + "|".join("-" * (w + 2) for w in widths) + "|"
+    return "\n".join([fmt.format(*head), sep, fmt.format(*k8s)])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kompat")
+    p.add_argument("file", help="compatibility.yaml path")
+    p.add_argument("-n", "--last-n", type=int, default=0,
+                   help="only the last N app versions")
+    p.add_argument("--check", action="store_true",
+                   help="exit non-zero unless --app-version is compatible "
+                        "with --k8s-version")
+    p.add_argument("--app-version", default="")
+    p.add_argument("--k8s-version", default="")
+    ns = p.parse_args(argv)
+    entries = load(ns.file)
+    if ns.check:
+        ok, msg = is_compatible(entries, ns.app_version, ns.k8s_version)
+        print(msg)
+        return 0 if ok else 1
+    print(markdown_table(entries, ns.last_n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
