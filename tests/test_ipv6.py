@@ -3,16 +3,13 @@ nodes provisioned in a single-stack IPv6 cluster bootstrap against the
 cluster's IPv6 kube-dns service IP — discovered from the control plane, or
 pinned per-pool through kubelet config."""
 
-from karpenter_tpu.api.objects import KubeletConfiguration, NodeClass, Pod
-from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.api.objects import KubeletConfiguration
 from karpenter_tpu.catalog.generate import generate_catalog
 from karpenter_tpu.cloud.fake import ImageInfo
-from karpenter_tpu.cloud.services import FakeControlPlane, FakeParameterStore
+from karpenter_tpu.cloud.services import FakeControlPlane
 from karpenter_tpu.operator.operator import Operator
 from karpenter_tpu.operator.options import Options
-from karpenter_tpu.providers.imagefamily import (ImageProvider, Resolver,
-                                                 generate_user_data)
-from karpenter_tpu.providers.version import VersionProvider
+from karpenter_tpu.providers.imagefamily import generate_user_data
 
 IPV6_DNS = "fd4e:9fbe:cd6a::a"
 

@@ -28,6 +28,8 @@ def _minor_range(lo: str, hi: str) -> List[str]:
     hi_maj, hi_min = (int(x) for x in hi.split(".")[:2])
     if lo_maj != hi_maj:
         raise ValueError(f"major version ranges unsupported: {lo}..{hi}")
+    if lo_min > hi_min:
+        raise ValueError(f"inverted version range: {lo}..{hi}")
     return [f"{lo_maj}.{m}" for m in range(lo_min, hi_min + 1)]
 
 
@@ -50,9 +52,13 @@ def load(path: str) -> List[Dict]:
         entries = doc.get("compatibility", [])
     if not entries:
         raise ValueError(f"{path}: no compatibility entries")
-    for e in entries:
+    for i, e in enumerate(entries):
         for key in ("appVersion", "minK8sVersion", "maxK8sVersion"):
+            if key not in e:
+                raise ValueError(f"{path}: entry {i} missing {key!r}")
             e[key] = _version_str(e[key])
+        # validate ranges eagerly so a swapped min/max fails loudly here
+        _minor_range(e["minK8sVersion"], e["maxK8sVersion"])
     return entries
 
 
@@ -103,6 +109,8 @@ def main(argv=None) -> int:
     ns = p.parse_args(argv)
     entries = load(ns.file)
     if ns.check:
+        if not ns.app_version or not ns.k8s_version:
+            p.error("--check requires --app-version and --k8s-version")
         ok, msg = is_compatible(entries, ns.app_version, ns.k8s_version)
         print(msg)
         return 0 if ok else 1
