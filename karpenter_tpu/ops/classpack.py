@@ -322,7 +322,8 @@ def solve_classpack(problem: Problem,
 
     if alloc.shape[0] == 0:  # no options and no existing nodes
         return PackingResult(
-            nodes=[], unschedulable=[p for m in problem.class_members for p in m],
+            nodes=[], unschedulable=[int(p) for m in problem.class_members
+                                     for p in m],
             existing_assignments={}, total_price=0.0)
     rank = np.zeros(alloc.shape[0], np.int32)
     rank[:O] = problem.option_rank
@@ -472,6 +473,11 @@ def solve_classpack(problem: Problem,
     compat_bits = np.packbits(problem.class_compat, axis=1)
     n_compat_cols = problem.class_compat.shape[1]
     option_alloc = problem.option_alloc
+    # two-level memo: the (pool, class-set) BASE — joint compat ∧ same pool,
+    # as candidate option ids — is shared by every node with that mix, so
+    # the per-used capacity filter only scans the base's few hundred rows
+    # instead of the whole O-column catalog on each distinct usage vector
+    base_memo: Dict[tuple, np.ndarray] = {}
     alt_memo: Dict[tuple, tuple] = {}
     nodes = []
     for i in range(len(oi_l)):
@@ -482,25 +488,27 @@ def solve_classpack(problem: Problem,
         mkey = (oi, cls, tuple(used_l[i]))
         hit = alt_memo.get(mkey)
         if hit is None:
-            # jointly compatible with every class on the node, big enough
-            # for its total usage, and from the same pool
-            used_vec = np.asarray(used_l[i], dtype=np.int64)
-            if len(cls) == 1:
-                jc = problem.class_compat[cls[0]]
-            else:
-                jc = np.unpackbits(
-                    np.bitwise_and.reduce(compat_bits[list(cls)], axis=0),
-                    count=n_compat_cols).astype(bool)
             pool = options_l[oi].pool
-            same_pool = pool_masks.get(pool)
-            if same_pool is None:
-                same_pool = pool_masks[pool] = pool_of_option == pool
+            bkey = (pool, cls)
+            base = base_memo.get(bkey)
+            if base is None:
+                if len(cls) == 1:
+                    jc = problem.class_compat[cls[0]]
+                else:
+                    jc = np.unpackbits(
+                        np.bitwise_and.reduce(compat_bits[list(cls)], axis=0),
+                        count=n_compat_cols).astype(bool)
+                same_pool = pool_masks.get(pool)
+                if same_pool is None:
+                    same_pool = pool_masks[pool] = pool_of_option == pool
+                base = base_memo[bkey] = np.nonzero(jc & same_pool)[0]
             # compare in option_alloc's own dtype: mixing the int used
-            # vector in promoted every row to float64 (~180µs/miss — the
-            # old decode hot spot)
-            cap_ok = (option_alloc
+            # vector in promoted every row to float64 (the old decode
+            # hot spot)
+            used_vec = np.asarray(used_l[i], dtype=np.int64)
+            cap_ok = (option_alloc[base]
                       >= used_vec.astype(option_alloc.dtype)).all(axis=1)
-            alt_ids = np.nonzero(jc & same_pool & cap_ok)[0][:max_alternatives]
+            alt_ids = base[cap_ok][:max_alternatives]
             hit = alt_memo[mkey] = (
                 [options_l[a] for a in alt_ids],
                 ResourceList.from_vector(used_vec, problem.axes,

@@ -185,6 +185,7 @@ class _Rewrites:
             # the copy's constraint fields diverge below — drop the
             # inherited spec caches (ops/tensorize._class_key, pod_is_soft)
             p.__dict__.pop("_ckey", None)
+            p.__dict__.pop("_cid", None)
             p.__dict__.pop("_soft", None)
             if strip_spread:
                 p.topology_spread = [c for c in pod.topology_spread

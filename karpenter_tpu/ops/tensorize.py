@@ -59,7 +59,8 @@ class Problem:
     class_requests: np.ndarray      # C×R float32
     class_counts: np.ndarray        # C int32
     class_compat: np.ndarray        # C×O bool
-    class_members: List[List[int]]  # class -> original pod indices
+    class_members: Sequence  # class -> original pod index vectors (int64
+                             # ndarrays from tensorize; plain lists OK too)
     # per launch option (column)
     options: List[LaunchOption]
     option_alloc: np.ndarray        # O×R float32
@@ -176,6 +177,32 @@ def _class_key(pod: Pod) -> tuple:
     )
     d["_ckey"] = k
     return k
+
+
+# class keys interned to small ints so the 50k-pod grouping loop can run in
+# numpy (np.unique over an int vector) instead of 50k Python dict round
+# trips.  Pod labels are part of the key, so distinct keys are unbounded in
+# a long-lived controller (per-pod-unique label values churn daily): the
+# table resets when it exceeds _CLASS_IDS_MAX, and a generation token on
+# the per-pod cache invalidates stale ids.  Resets happen ONLY between
+# tensorize calls (see tensorize) — a mid-call reset would let two distinct
+# keys share an id and silently merge classes.
+_CLASS_IDS: Dict[tuple, int] = {}
+_CLASS_GEN = [0]
+_CLASS_IDS_MAX = 1 << 17
+
+
+def _class_id(pod: Pod) -> int:
+    d = pod.__dict__
+    tok = d.get("_cid")
+    if tok is not None and tok[0] == _CLASS_GEN[0]:
+        return tok[1]
+    k = _class_key(pod)
+    cid = _CLASS_IDS.get(k)
+    if cid is None:
+        cid = _CLASS_IDS[k] = len(_CLASS_IDS)
+    d["_cid"] = (_CLASS_GEN[0], cid)
+    return cid
 
 
 _CAP_BIG = 2**30
@@ -413,18 +440,29 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
     side = catalog_side(catalog, nodepools, axes)
     O, R = len(side.options), len(axes)
 
-    # pod equivalence classes
-    classes: Dict[tuple, int] = {}
-    members: List[List[int]] = []
-    reps: List[Pod] = []
-    for i, pod in enumerate(pods):
-        k = _class_key(pod)
-        ci = classes.get(k)
-        if ci is None:
-            ci = classes[k] = len(members)
-            members.append([])
-            reps.append(pod)
-        members[ci].append(i)
+    # pod equivalence classes, grouped in numpy over interned class ids —
+    # one attribute read per pod instead of a dict-build round trip; class
+    # order stays first-appearance (the old dict semantics) so tie-breaks
+    # and decode order are unchanged
+    n = len(pods)
+    if len(_CLASS_IDS) >= _CLASS_IDS_MAX:   # bound the intern table; never
+        _CLASS_IDS.clear()                  # resets mid-call (id collisions
+        _CLASS_GEN[0] += 1                  # would merge distinct classes)
+    if n:
+        ids = np.fromiter((_class_id(p) for p in pods), np.int64, count=n)
+        uniq, first, inverse = np.unique(ids, return_index=True,
+                                         return_inverse=True)
+        appear = np.argsort(first, kind="stable")
+        rank = np.empty(len(uniq), np.int64)
+        rank[appear] = np.arange(len(uniq))
+        ci_of_pod = rank[inverse]
+        reps = [pods[first[o]] for o in appear]
+        by_class = np.argsort(ci_of_pod, kind="stable")
+        counts = np.bincount(ci_of_pod, minlength=len(uniq))
+        members = np.split(by_class, np.cumsum(counts)[:-1])
+    else:  # np.split of an empty vector would yield ONE empty group
+        reps, members = [], []
+        counts = np.zeros(0, np.int64)
 
     C = len(reps)
     class_requests = np.zeros((C, R), np.float32)
@@ -438,7 +476,7 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
     return Problem(
         axes=axes,
         class_requests=class_requests,
-        class_counts=np.asarray([len(m) for m in members], np.int32),
+        class_counts=counts.astype(np.int32),
         class_compat=class_compat,
         class_members=members,
         class_node_cap=np.asarray([_node_cap(rep) for rep in reps], np.int32),

@@ -213,3 +213,27 @@ class TestCatalogSideCache:
         cat[0].requirements["custom/team"] = Requirement("custom/team", IN, ["ml"])
         s2 = catalog_side(cat, pools)
         assert s1 is not s2
+
+
+class TestClassIdInterning:
+    def test_reset_between_calls_regroups_correctly(self):
+        """The intern-table reset (bounding long-lived memory growth) must
+        not merge or split classes: stale per-pod ids are invalidated by
+        the generation token and re-interned."""
+        import karpenter_tpu.ops.tensorize as tz
+        cat = small_catalog()
+        pods = [Pod(requests=ResourceList({CPU: 100 * (1 + i % 3)}))
+                for i in range(12)]
+        p1 = tz.tensorize(pods, cat, [NodePool()])
+        assert p1.num_classes == 3
+        # simulate the bound being hit: clear + bump generation
+        tz._CLASS_IDS.clear()
+        tz._CLASS_GEN[0] += 1
+        mixed = pods + [Pod(requests=ResourceList({CPU: 100 * (1 + i % 3)}))
+                        for i in range(6)]
+        p2 = tz.tensorize(mixed, cat, [NodePool()])
+        assert p2.num_classes == 3
+        assert sorted(p2.class_counts.tolist()) == [6, 6, 6]
+        # members must partition the pod index space exactly
+        all_members = sorted(int(i) for m in p2.class_members for i in m)
+        assert all_members == list(range(18))
