@@ -220,7 +220,8 @@ class TestClassIdInterning:
         """The intern-table reset (bounding long-lived memory growth) must
         not merge or split classes: stale per-pod ids are invalidated by
         the generation token and re-interned."""
-        import karpenter_tpu.ops.tensorize as tz
+        import importlib
+        tz = importlib.import_module("karpenter_tpu.ops.tensorize")
         cat = small_catalog()
         pods = [Pod(requests=ResourceList({CPU: 100 * (1 + i % 3)}))
                 for i in range(12)]
