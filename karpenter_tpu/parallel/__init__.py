@@ -1,1 +1,2 @@
-from .sharded import (SHARD_AXIS, make_pod_mesh, solve_sharded, split_counts)
+from .sharded import (DCN_AXIS, ICI_AXIS, SHARD_AXIS, make_host_mesh,
+                      make_pod_mesh, solve_sharded, split_counts)

@@ -33,6 +33,11 @@ from ..ops.classpack import class_pack_aggregate_kernel
 from ..ops.tensorize import Problem, pad_to
 
 SHARD_AXIS = "pods"
+# hybrid-mesh axis names: the host axis rides DCN, the per-host chip axis
+# rides ICI — collectives reduce over ICI first so only one partial per
+# host crosses the (slower) data-center network
+DCN_AXIS = "hosts"
+ICI_AXIS = "chips"
 
 
 def make_pod_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -42,6 +47,31 @@ def make_pod_mesh(n_devices: Optional[int] = None) -> Mesh:
         raise ValueError(f"requested {n}-device mesh but only {len(devs)} "
                          f"devices are available")
     return Mesh(np.asarray(devs[:n]), (SHARD_AXIS,))
+
+
+def make_host_mesh(n_hosts: int, chips_per_host: Optional[int] = None) -> Mesh:
+    """2-D (hosts × chips) mesh for multi-host fleets.  On real multi-host
+    TPU pods, build the device array with
+    `jax.experimental.mesh_utils.create_hybrid_device_mesh` so the host
+    axis maps onto DCN and the chip axis onto ICI; the (h, c) reshape here
+    covers single-controller/virtual setups where device order IS host
+    order (tests use a virtual 8-CPU mesh shaped 2×4)."""
+    devs = jax.devices()
+    if chips_per_host is None:
+        if n_hosts <= 0 or len(devs) % n_hosts:
+            # inferring chips must not silently drop devices (8 devices /
+            # 3 hosts would strand 2) or produce an empty 0-chip mesh
+            raise ValueError(
+                f"{len(devs)} devices do not divide over {n_hosts} hosts; "
+                f"pass chips_per_host explicitly")
+        chips = len(devs) // n_hosts
+    else:
+        chips = chips_per_host
+    if n_hosts <= 0 or chips <= 0 or n_hosts * chips > len(devs):
+        raise ValueError(f"requested {n_hosts}x{chips} mesh but only "
+                         f"{len(devs)} devices are available")
+    grid = np.asarray(devs[:n_hosts * chips]).reshape(n_hosts, chips)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
 
 
 def split_counts(counts: np.ndarray, n_shards: int) -> np.ndarray:
@@ -62,37 +92,49 @@ def split_counts(counts: np.ndarray, n_shards: int) -> np.ndarray:
 def _sharded_pack(requests, counts_sharded, compat, node_cap, alloc, price,
                   rank, max_nodes_per_shard: int, mesh: Mesh):
     """shard_map'd pack: every device scans its pod slice, then the launch
-    plan is psum-aggregated over the mesh."""
+    plan is reduced over the mesh.  On a 1-D mesh that is one psum; on a
+    hybrid (hosts × chips) mesh the reduction is hierarchical — psum over
+    the ICI axis first (fast intra-host links), then over the DCN axis, so
+    each host sends ONE partial plan across the slow network."""
     O = alloc.shape[0]
+    axes = tuple(mesh.axis_names)
+    unit_dims = len(axes)
 
     def shard_fn(counts_local):
-        counts_local = counts_local[0]        # drop the unit shard dim
+        for _ in range(unit_dims):            # drop the unit shard dims
+            counts_local = counts_local[0]
         K = max_nodes_per_shard
         # mark per-shard state as mesh-varying (each device packs its own bins)
         init_option = jax.lax.pcast(jnp.full((K,), -1, jnp.int32),
-                                    (SHARD_AXIS,), to='varying')
+                                    axes, to='varying')
         init_used = jax.lax.pcast(
             jnp.zeros((K, requests.shape[1]), jnp.int32),
-            (SHARD_AXIS,), to='varying')
+            axes, to='varying')
         # same guarded reduction as the single-chip aggregate path —
         # flat = [cost, n_open, n_unsched, nodes_per_option…]
         flat = class_pack_aggregate_kernel(
             requests, counts_local, compat, node_cap, alloc, price, rank,
             init_option, init_used, K)
-        # ICI collective: the global launch plan every host can act on
-        return jax.lax.psum(flat, SHARD_AXIS)[None]
+        # innermost (ICI) axis reduces first; the host/DCN axis reduces the
+        # per-host partials
+        for ax in reversed(axes):
+            flat = jax.lax.psum(flat, ax)
+        return flat[(None,) * unit_dims]
 
     fn = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(SHARD_AXIS),),
-        out_specs=P(SHARD_AXIS))
-    flat = fn(counts_sharded)[0]
+        in_specs=(P(*axes),),
+        out_specs=P(*axes))
+    flat = fn(counts_sharded)
+    for _ in range(unit_dims):
+        flat = flat[0]
     return flat[0], flat[3:3 + O].astype(jnp.int32), flat[2].astype(jnp.int32)
 
 
 def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
                   max_nodes_per_shard: int = 4096):
-    """Pack a Problem over a device mesh. Returns
+    """Pack a Problem over a device mesh — 1-D (pods) or hybrid 2-D
+    (hosts × chips).  Returns
     (total_cost, nodes_per_option O int array, unscheduled count)."""
     mesh = mesh or make_pod_mesh()
     n = mesh.devices.size
@@ -120,6 +162,8 @@ def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
     counts_sharded = np.zeros((n, Cpad), np.int32)
     counts_sharded[:, :C] = split_counts(
         problem.class_counts[order].astype(np.int32), n)
+    # a hybrid mesh shards the same flat split over (hosts, chips)
+    counts_sharded = counts_sharded.reshape(*mesh.devices.shape, Cpad)
 
     cost, nodes_per_option, unsched = _sharded_pack(
         jnp.asarray(requests), jnp.asarray(counts_sharded), jnp.asarray(compat),

@@ -45,3 +45,37 @@ def test_sharded_runs_on_smaller_mesh():
     cost4, _, u4 = solve_sharded(prob, make_pod_mesh(4), max_nodes_per_shard=64)
     assert u2 == 0 and u4 == 0
     assert cost2 > 0 and cost4 > 0
+
+
+class TestHybridMesh:
+    """Multi-host decomposition: the same solve over a 2-D (hosts × chips)
+    mesh with hierarchical psum (ICI first, one partial per host over DCN)
+    must agree exactly with the 1-D mesh plan."""
+
+    def test_host_mesh_matches_flat_mesh(self):
+        from karpenter_tpu.parallel import (make_host_mesh, make_pod_mesh,
+                                            solve_sharded)
+        pods = ([cpu_pod(cpu_m=1500, mem_mib=1024) for _ in range(40)]
+                + [cpu_pod(cpu_m=300, mem_mib=256) for _ in range(80)])
+        prob = tensorize(pods, small_catalog(), [NodePool()])
+        flat_cost, flat_plan, flat_un = solve_sharded(
+            prob, make_pod_mesh(8), max_nodes_per_shard=64)
+        hyb_cost, hyb_plan, hyb_un = solve_sharded(
+            prob, make_host_mesh(2, 4), max_nodes_per_shard=64)
+        assert hyb_un == flat_un == 0
+        assert hyb_cost == pytest.approx(flat_cost)
+        assert (hyb_plan == flat_plan).all()
+
+    def test_host_mesh_shape_validation(self):
+        from karpenter_tpu.parallel import make_host_mesh
+        with pytest.raises(ValueError):
+            make_host_mesh(4, 4)   # 16 devices > the 8 available
+        with pytest.raises(ValueError):
+            make_host_mesh(16)     # inferred chips would be 0
+        with pytest.raises(ValueError):
+            make_host_mesh(3)      # 8 devices don't divide over 3 hosts
+        with pytest.raises(ValueError):
+            make_host_mesh(2, 0)   # explicit zero chips
+        mesh = make_host_mesh(2)   # chips inferred: 8 // 2
+        assert mesh.devices.shape == (2, 4)
+        assert mesh.axis_names == ("hosts", "chips")
