@@ -256,7 +256,10 @@ def validate_manifest(manifest: Dict) -> None:
     every violation listed."""
     from .serialize import crd_schemas
     kind = manifest.get("kind", "")
-    schema = crd_schemas().get(kind)
+    # the reference publishes AWSNodeTemplate under both spellings; one
+    # schema covers both (legacy.py converts either)
+    schema = crd_schemas().get(
+        "NodeTemplate" if kind == "AWSNodeTemplate" else kind)
     if schema is None:
         raise ValidationError(f"unknown kind {kind!r}")
     try:

@@ -1,11 +1,11 @@
 """Legacy (alpha-era) API kinds and their conversion to the current API.
 
-The reference carries two deprecated generations — `Provisioner`
-(karpenter.sh/v1alpha5) and `AWSNodeTemplate`
+The reference carries three deprecated alpha-era kinds — `Provisioner`
+and `Machine` (karpenter.sh/v1alpha5) and `AWSNodeTemplate`
 (/root/reference/pkg/apis/v1alpha1/awsnodetemplate.go:95 + provider.go:24)
-— and ships `karpenter-convert` to migrate manifests to
-NodePool/EC2NodeClass (/root/reference/tools/karpenter-convert/README.md:1-10).
-This module is both halves: the legacy manifest shapes and the conversion.
+— and ships `karpenter-convert` to migrate manifests to the current API
+(/root/reference/tools/karpenter-convert/README.md:1-10).  This module is
+both halves: the legacy manifest shapes and the conversion.
 """
 
 from __future__ import annotations
@@ -98,6 +98,51 @@ def convert_node_template(m: Dict) -> Dict:
     return nodeclass_to_manifest(nc)
 
 
+def convert_machine(m: Dict) -> Dict:
+    """Legacy Machine (machine-era NodeClaim, karpenter.sh/v1alpha5) →
+    NodeClaim manifest.
+
+    Field moves: the owning provisioner label → nodePoolRef,
+    machineTemplateRef → nodeClassRef, requirements/taints/resources carry
+    over, status.providerID and the launch metadata survive so hydrated
+    fleets keep their identity.  Built through NodeClaim +
+    nodeclaim_to_manifest like the sibling converters, so the wire shape
+    has exactly one owner (serialize.py)."""
+    from .objects import NodeClaim
+    from .serialize import nodeclaim_to_manifest
+    spec = m.get("spec", {})
+    status = m.get("status", {})
+    meta = m.get("metadata", {})
+    pool = meta.get("labels", {}).get("karpenter.sh/provisioner-name",
+                                      spec.get("provisionerRef", {})
+                                      .get("name", "default"))
+    claim = NodeClaim(
+        nodepool=pool,
+        node_class_ref=spec.get("machineTemplateRef", {}).get("name",
+                                                              "default"),
+        requirements=Requirements.of(*[requirement_from_dict(r)
+                                       for r in spec.get("requirements", [])]),
+        requests=ResourceList.parse(
+            spec.get("resources", {}).get("requests", {}) or {}),
+        taints=[taint_from_dict(t) for t in spec.get("taints", [])],
+        labels=dict(meta.get("labels", {})),
+    )
+    if meta.get("name"):
+        claim.name = meta["name"]
+    # provider-created claims carry the nodepool label; migrated ones must
+    # too, or pool-keyed selectors/lookups treat the node as pool-less
+    from . import labels as wk
+    claim.labels.setdefault(wk.NODEPOOL, pool)
+    claim.provider_id = status.get("providerID", "")
+    claim.instance_type = status.get("instanceType", "")
+    claim.zone = status.get("zone", "")
+    claim.capacity_type = status.get("capacityType", "")
+    claim.image_id = status.get("imageID", "")
+    claim.price = float(status.get("price", 0.0))
+    claim.launched_at = float(status.get("launchedAt", 0.0))
+    return nodeclaim_to_manifest(claim)
+
+
 def convert_manifest(m: Dict) -> Dict:
     """Dispatch on kind; current-API kinds pass through unchanged."""
     kind = m.get("kind", "")
@@ -105,6 +150,8 @@ def convert_manifest(m: Dict) -> Dict:
         return convert_provisioner(m)
     if kind in ("NodeTemplate", "AWSNodeTemplate"):
         return convert_node_template(m)
-    if kind in ("NodePool", "NodeClass"):
+    if kind == "Machine":
+        return convert_machine(m)
+    if kind in ("NodePool", "NodeClass", "NodeClaim"):
         return m
     raise ValueError(f"cannot convert kind {kind!r}")

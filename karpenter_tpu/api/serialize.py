@@ -231,12 +231,16 @@ def nodeclaim_to_manifest(claim: NodeClaim) -> Dict:
         spec["nodeClassHash"] = claim.node_class_hash
     if claim.provider_id:
         status["providerID"] = claim.provider_id
-        status.update({"instanceType": claim.instance_type,
-                       "zone": claim.zone,
-                       "capacityType": claim.capacity_type,
-                       "imageID": claim.image_id,
-                       "price": claim.price,
-                       "launchedAt": claim.launched_at})
+        # empty launch metadata is omitted, not emitted as "" — partially
+        # populated claims (e.g. migrated legacy Machine records) must
+        # still pass the CRD schema's enums
+        status.update({k: v for k, v in {
+            "instanceType": claim.instance_type,
+            "zone": claim.zone,
+            "capacityType": claim.capacity_type,
+            "imageID": claim.image_id,
+            "price": claim.price,
+            "launchedAt": claim.launched_at}.items() if v})
     conds = []
     if claim.launched:
         conds.append({"type": "Launched", "status": "True"})
@@ -311,7 +315,78 @@ def crd_schemas() -> Dict[str, Dict]:
             "effect": {"enum": ["NoSchedule", "PreferNoSchedule", "NoExecute"]},
         },
     }
+    # deprecated alpha-era kinds (reference ships CRDs for its legacy
+    # generations too: provisioners/machines/awsnodetemplates in
+    # /root/reference/pkg/apis/crds/); `tools/convert.py` migrates them and
+    # `api/legacy.py` converts on apply — the schemas document the accepted
+    # wire shapes
+    provisioner_schema = {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "title": f"Provisioner.{GROUP}/v1alpha5 (deprecated)",
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "requirements": {"type": "array",
+                                     "items": requirement_schema},
+                    "taints": {"type": "array", "items": taint_schema},
+                    "startupTaints": {"type": "array", "items": taint_schema},
+                    "labels": {"type": "object"},
+                    "providerRef": {"type": "object"},
+                    "ttlSecondsAfterEmpty": {"type": "number", "minimum": 0},
+                    "ttlSecondsUntilExpired": {"type": "number", "minimum": 0},
+                    "consolidation": {"type": "object"},
+                    "limits": {"type": "object"},
+                    "weight": {"type": "integer", "minimum": 0,
+                               "maximum": 100},
+                },
+            },
+        },
+    }
+    machine_schema = {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "title": f"Machine.{GROUP}/v1alpha5 (deprecated)",
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "machineTemplateRef": {"type": "object"},
+                    "requirements": {"type": "array",
+                                     "items": requirement_schema},
+                    "taints": {"type": "array", "items": taint_schema},
+                    "resources": {"type": "object"},
+                },
+            },
+            "status": {"type": "object"},
+        },
+    }
+    nodetemplate_schema = {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "title": f"NodeTemplate.{GROUP}/v1alpha5 (deprecated)",
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "amiFamily": {"type": "string"},
+                    "subnetSelector": {"type": "object"},
+                    "securityGroupSelector": {"type": "object"},
+                    "amiSelector": {"type": "object"},
+                    "instanceProfile": {"type": "string"},
+                    "role": {"type": "string"},
+                    "userData": {"type": "string"},
+                    "tags": {"type": "object"},
+                    "blockDeviceMappings": {"type": "array"},
+                },
+            },
+        },
+    }
     return {
+        "Provisioner": provisioner_schema,
+        "Machine": machine_schema,
+        "NodeTemplate": nodetemplate_schema,
         "NodePool": {
             "$schema": "https://json-schema.org/draft/2020-12/schema",
             "title": f"NodePool.{GROUP}/{VERSION}",

@@ -157,6 +157,11 @@ class Operator:
         from ..api.legacy import convert_manifest
         from ..api.serialize import (nodeclass_from_manifest,
                                      nodepool_from_manifest)
+        # legacy manifests are schema-checked against THEIR OWN kind's
+        # schema before conversion — a malformed Provisioner/Machine gets an
+        # admission error naming the kind the user submitted, not a raw
+        # converter exception or an error about the converted kind
+        validate_manifest(manifest)
         manifest = convert_manifest(manifest)
         validate_manifest(manifest)
         kind = manifest.get("kind")
@@ -173,6 +178,27 @@ class Operator:
             self.node_classes[nc.name] = nc
             log.info("applied NodeClass %s", nc.name)
             return nc
+        if kind == "NodeClaim":
+            # normally machine-created; applying one (e.g. a migrated legacy
+            # Machine record) registers it into cluster state. A claim with
+            # a live instance goes through the same promotion as restart
+            # hydration so its capacity is schedulable and disruptable —
+            # not just GC-protected.
+            from ..api.serialize import nodeclaim_from_manifest
+            claim = nodeclaim_from_manifest(manifest)
+            if claim.provider_id and not self.cluster.claim_for_provider_id(
+                    claim.provider_id):
+                it = next((t for t in self.catalog
+                           if t.name == claim.instance_type), None)
+                allocatable = it.allocatable if it else claim.requests
+                claim.created_at = claim.created_at or claim.launched_at
+                node = self.cluster.register_nodeclaim(
+                    claim, allocatable, it.capacity if it else None)
+                node.created_at = claim.launched_at or node.created_at
+            else:
+                self.cluster.nodeclaims[claim.name] = claim
+            log.info("applied NodeClaim %s", claim.name)
+            return claim
         raise ValueError(f"cannot apply kind {kind!r}")
 
     def delete(self, kind: str, name: str) -> bool:

@@ -57,17 +57,20 @@ def make_host_mesh(n_hosts: int, chips_per_host: Optional[int] = None) -> Mesh:
     covers single-controller/virtual setups where device order IS host
     order (tests use a virtual 8-CPU mesh shaped 2×4)."""
     devs = jax.devices()
+    if n_hosts <= 0 or (chips_per_host is not None and chips_per_host <= 0):
+        raise ValueError(f"mesh axes must be positive, got "
+                         f"{n_hosts}x{chips_per_host}")
     if chips_per_host is None:
-        if n_hosts <= 0 or len(devs) % n_hosts:
+        if len(devs) % n_hosts:
             # inferring chips must not silently drop devices (8 devices /
-            # 3 hosts would strand 2) or produce an empty 0-chip mesh
+            # 3 hosts would strand 2)
             raise ValueError(
                 f"{len(devs)} devices do not divide over {n_hosts} hosts; "
                 f"pass chips_per_host explicitly")
         chips = len(devs) // n_hosts
     else:
         chips = chips_per_host
-    if n_hosts <= 0 or chips <= 0 or n_hosts * chips > len(devs):
+    if n_hosts * chips > len(devs):
         raise ValueError(f"requested {n_hosts}x{chips} mesh but only "
                          f"{len(devs)} devices are available")
     grid = np.asarray(devs[:n_hosts * chips]).reshape(n_hosts, chips)

@@ -444,3 +444,30 @@ class TestKompat:
         assert kompat.main([path, "-n", "1"]) == 0
         out = capsys.readouterr().out
         assert "0.31.0" in out and "1.24 - 1.28" in out
+
+def test_apply_legacy_machine_registers_nodeclaim():
+    """A migrated legacy Machine record applies end-to-end: converted
+    to a NodeClaim and registered into cluster state."""
+    op = Operator(Options(), catalog=generate_catalog(10))
+    claim = op.apply({
+        "apiVersion": "karpenter.tpu/v1alpha5", "kind": "Machine",
+        "metadata": {"name": "machine-7",
+                     "labels": {"karpenter.sh/provisioner-name": "p"}},
+        "spec": {"machineTemplateRef": {"name": "default"}},
+        "status": {"providerID": "i-m7", "instanceType": "a.small"}})
+    assert op.cluster.nodeclaims["machine-7"] is claim
+    assert claim.nodepool == "p"
+    assert claim.provider_id == "i-m7"
+    # live-instance claims promote to full Nodes (schedulable capacity),
+    # exactly like restart hydration
+    node = op.cluster.node_for_provider_id("i-m7")
+    assert node is not None
+    from karpenter_tpu.api import labels as wk
+    assert node.labels.get(wk.NODEPOOL) == "p"
+    # malformed LEGACY manifests are rejected by their OWN kind's schema
+    import pytest as _pytest
+    from karpenter_tpu.api.admission import ValidationError
+    with _pytest.raises(ValidationError):
+        op.apply({"apiVersion": "karpenter.tpu/v1alpha5", "kind": "Machine",
+                  "metadata": {"name": "bad"},
+                  "spec": {"requirements": [{"operator": "In"}]}})

@@ -93,7 +93,8 @@ class TestNodeClassRoundtrip:
 
     def test_schemas_validate_shapes(self):
         schemas = crd_schemas()
-        assert set(schemas) == {"NodePool", "NodeClass", "NodeClaim"}
+        assert set(schemas) == {"NodePool", "NodeClass", "NodeClaim",
+                                "Provisioner", "Machine", "NodeTemplate"}
         # sanity: generated manifests carry the right top-level keys
         m = nodepool_to_manifest(NodePool())
         assert set(schemas["NodePool"]["required"]) <= set(m)
@@ -259,4 +260,44 @@ class TestNodeClaimSerialize:
         jsonschema.Draft202012Validator(schema).validate(m)
         bad = {"kind": "NodeClaim", "spec": {}}   # missing nodePoolRef
         errs = list(jsonschema.Draft202012Validator(schema).iter_errors(bad))
+        assert errs
+
+
+class TestMachineConversion:
+    def test_machine_to_nodeclaim(self):
+        from karpenter_tpu.api.legacy import convert_manifest
+        from karpenter_tpu.api.serialize import nodeclaim_from_manifest
+        m = {"apiVersion": "karpenter.tpu/v1alpha5", "kind": "Machine",
+             "metadata": {"name": "machine-1",
+                          "labels": {"karpenter.sh/provisioner-name": "team-a"}},
+             "spec": {
+                 "machineTemplateRef": {"name": "gpu"},
+                 "requirements": [{"key": "kubernetes.io/arch",
+                                   "operator": "In", "values": ["amd64"]}],
+                 "taints": [{"key": "dedicated", "effect": "NoSchedule"}],
+                 "resources": {"requests": {"cpu": "2", "memory": "4Gi"}},
+             },
+             "status": {"providerID": "i-abc", "instanceType": "a.large",
+                        "zone": "zone-b", "capacityType": "spot"}}
+        out = convert_manifest(m)
+        assert out["kind"] == "NodeClaim"
+        claim = nodeclaim_from_manifest(out)
+        assert claim.nodepool == "team-a"
+        assert claim.node_class_ref == "gpu"
+        assert claim.provider_id == "i-abc"
+        assert claim.capacity_type == "spot"
+        assert claim.requests == claim.requests.parse(
+            {"cpu": "2", "memory": "4Gi"})
+        assert [t.key for t in claim.taints] == ["dedicated"]
+
+    def test_legacy_schemas_validate_legacy_manifests(self):
+        import jsonschema
+        from karpenter_tpu.api.serialize import crd_schemas
+        schemas = crd_schemas()
+        prov = {"kind": "Provisioner",
+                "spec": {"ttlSecondsAfterEmpty": 30, "weight": 10}}
+        jsonschema.Draft202012Validator(schemas["Provisioner"]).validate(prov)
+        bad = {"kind": "Provisioner", "spec": {"weight": 9000}}
+        errs = list(jsonschema.Draft202012Validator(
+            schemas["Provisioner"]).iter_errors(bad))
         assert errs
