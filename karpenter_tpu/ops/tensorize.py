@@ -15,7 +15,7 @@ per-(pod,instance-type) inner loop of the FFD scheduler described in
     (/root/reference/pkg/providers/instance/instance.go:327-367);
   * the result is a `Problem` of dense arrays (requests C×R / P×R, compat
     C×O / P×O, allocatable O×R, price O) that the jit-compiled kernels in
-    karpenter_tpu.ops.{ffd,sinkhorn} consume with static shapes.
+    karpenter_tpu.ops.{ffd,classpack,lpbound} consume with static shapes.
 
 Shape discipline: `pad_to` buckets P and O up to fixed sizes so recompiles
 are bounded (SURVEY.md §7 hard part iv).
