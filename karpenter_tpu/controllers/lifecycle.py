@@ -104,7 +104,6 @@ class LifecycleController:
         allocatable = it.allocatable if it else claim.requests
         node = self.cluster.register_nodeclaim(
             claim, allocatable, it.capacity if it else None, initialized=False)
-        claim.registered_at = self.clock()
         # registration leaves startup taints in place; initialization clears
         # them (claim was created with pool startup taints included)
         self._pending.pop(claim.name, None)
@@ -148,6 +147,9 @@ class LifecycleController:
             return  # capacity not reported yet
         claim.initialized = True
         claim.initialized_at = self.clock()
+        if claim.registered_at:
+            metrics.nodeclaim_initialization_duration().observe(
+                max(0.0, claim.initialized_at - claim.registered_at))
         node.labels[wk.NODE_INITIALIZED] = "true"
         out.initialized.append(node.name)
         self.recorder.publish(Event("Node", node.name, "Initialized", ""))

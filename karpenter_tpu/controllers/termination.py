@@ -30,6 +30,7 @@ from ..api import labels as wk
 from ..cloud.fake import CloudError
 from ..cloud.provider import CloudProvider
 from ..state.cluster import Cluster
+from ..utils import metrics
 
 log = logging.getLogger("karpenter_tpu.termination")
 
@@ -53,6 +54,7 @@ class TerminationController:
         self.cluster = cluster
         self.clock = clock
         self._queue: Dict[str, str] = {}   # node name → reason
+        self._requested_at: Dict[str, float] = {}  # drain-start stamps
 
     # ------------------------------------------------------------------
     def request(self, node: Node, reason: str = "") -> None:
@@ -64,6 +66,7 @@ class TerminationController:
         node.taints = [t for t in node.taints
                        if t.key != TERMINATION_TAINT.key] + [TERMINATION_TAINT]
         self._queue.setdefault(node.name, reason)
+        self._requested_at.setdefault(node.name, self.clock())
 
     @property
     def pending(self) -> List[str]:
@@ -77,6 +80,7 @@ class TerminationController:
             node = self.cluster.nodes.get(name)
             if node is None:           # already gone — drop the finalizer
                 del self._queue[name]
+                self._requested_at.pop(name, None)
                 continue
             self._drain_one(node, out)
         return out
@@ -137,6 +141,10 @@ class TerminationController:
             self.cluster.delete_pod(p)
         self.cluster.remove_node(node.name)
         self._queue.pop(node.name, None)
+        started = self._requested_at.pop(node.name, None)
+        if started is not None:
+            metrics.termination_duration().observe(
+                max(0.0, self.clock() - started))
         out.terminated.append(node.name)
         log.info("terminated node %s", node.name)
 

@@ -139,7 +139,8 @@ class Operator:
             allocatable = it.allocatable if it else claim.requests
             claim.created_at = claim.created_at or claim.launched_at
             node = self.cluster.register_nodeclaim(
-                claim, allocatable, it.capacity if it else None)
+                claim, allocatable, it.capacity if it else None,
+                rehydrate=True)
             # recovered nodes keep their original age so expiry still works
             node.created_at = claim.launched_at or node.created_at
             n += 1
@@ -193,7 +194,8 @@ class Operator:
                 allocatable = it.allocatable if it else claim.requests
                 claim.created_at = claim.created_at or claim.launched_at
                 node = self.cluster.register_nodeclaim(
-                    claim, allocatable, it.capacity if it else None)
+                    claim, allocatable, it.capacity if it else None,
+                    rehydrate=True)
                 node.created_at = claim.launched_at or node.created_at
             else:
                 self.cluster.nodeclaims[claim.name] = claim

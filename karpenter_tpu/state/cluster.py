@@ -112,14 +112,30 @@ class Cluster:
 
     def register_nodeclaim(self, claim: NodeClaim, allocatable: ResourceList,
                            capacity: Optional[ResourceList] = None,
-                           initialized: bool = True) -> Node:
+                           initialized: bool = True,
+                           rehydrate: bool = False) -> Node:
         """NodeClaim → Node on (simulated) kubelet join; lifecycle per
         SURVEY §2.2 NodeClaim lifecycle.  The sync provisioning path
         registers+initializes in one step (instant fake kubelet); the async
         LifecycleController passes initialized=False and runs the
-        initialization pass separately."""
+        initialization pass separately.  ``rehydrate`` marks restart
+        recovery — rebuilding state for an already-registered node is not a
+        registration event, so the latency histograms stay clean."""
         claim.registered = True
+        claim.registered_at = claim.registered_at or self.clock()
         claim.initialized = initialized
+        if initialized and not claim.initialized_at:
+            claim.initialized_at = self.clock()
+        if not rehydrate:
+            # registration/initialization latency families — the sync path
+            # records its true (instant) joins, the async lifecycle path its
+            # real delays (reference karpenter_nodeclaims_* durations)
+            if claim.launched_at:
+                metrics.nodeclaim_registration_duration().observe(
+                    max(0.0, claim.registered_at - claim.launched_at))
+            if initialized:
+                metrics.nodeclaim_initialization_duration().observe(
+                    max(0.0, claim.initialized_at - claim.registered_at))
         self.nodeclaims[claim.name] = claim
         node = Node(
             name=f"node-{next(_names):06d}",

@@ -353,3 +353,28 @@ def cloud_errors_total() -> Counter:
         "karpenter_cloudprovider_errors_total",
         "Cloud API errors by classification.",
         labels=("classification",))
+
+
+def nodeclaim_registration_duration() -> Histogram:
+    """launch → kubelet join latency (reference
+    karpenter_nodeclaims_registration_duration_seconds family)."""
+    return REGISTRY.histogram(
+        "karpenter_nodeclaims_registration_duration_seconds",
+        "Time from launch to node registration.",
+        buckets=(1, 5, 15, 30, 60, 120, 300, 600, 900))
+
+
+def nodeclaim_initialization_duration() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_nodeclaims_initialization_duration_seconds",
+        "Time from registration to node initialization.",
+        buckets=(1, 5, 15, 30, 60, 120, 300, 600, 900))
+
+
+def termination_duration() -> Histogram:
+    """drain start → instance gone (reference
+    karpenter_nodes_termination_time_seconds family)."""
+    return REGISTRY.histogram(
+        "karpenter_nodes_termination_duration_seconds",
+        "Time from drain request to instance termination.",
+        buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800))

@@ -94,3 +94,34 @@ def test_pods_bound_duration_measures_arrival_to_bind():
     hist = metrics.pods_bound_duration()
     assert hist.count() == 1
     assert abs(hist.sum() - 2.5) < 1e-6
+
+
+def test_lifecycle_and_termination_durations_emit():
+    """Launch→register, register→initialize, and drain→terminate latencies
+    land in their histograms with fake-clock-exact values."""
+    from karpenter_tpu.controllers import TerminationController
+    from karpenter_tpu.controllers.lifecycle import LifecycleController
+    from karpenter_tpu.api.objects import NodeClaim
+    pools = [NodePool()]
+    clock, cluster, prov, provider = env(pools)
+    lc = LifecycleController(provider, cluster,
+                             nodepools={"default": pools[0]},
+                             clock=clock, join_delay=5.0)
+    claim = provider.create(NodeClaim(nodepool="default"))
+    lc.track(claim)
+    clock.t += 7.0                       # join delay elapses
+    lc.reconcile()                       # registers
+    assert claim.registered
+    lc.reconcile()                       # initializes
+    assert claim.initialized
+    reg = metrics.nodeclaim_registration_duration()
+    init = metrics.nodeclaim_initialization_duration()
+    assert reg.count() == 1 and abs(reg.sum() - 7.0) < 1e-6
+    assert init.count() == 1
+    term = TerminationController(provider, cluster, clock=clock)
+    node = cluster.node_for_provider_id(claim.provider_id)
+    term.request(node, reason="test")
+    clock.t += 3.0
+    term.reconcile()
+    hist = metrics.termination_duration()
+    assert hist.count() == 1 and abs(hist.sum() - 3.0) < 1e-6
