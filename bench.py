@@ -224,9 +224,11 @@ def run_all():
     # config 4: 500-node consolidation replay
     run_consolidation_replay()
     # config 5 (headline): 50k burst, 600 types, constraints + spot/od pricing
+    # (9 timed iterations: machine-load outliers on shared hosts/tunnels are
+    # 1-2 per burst, so a wider sample keeps the p50 on the true latency)
     headline_pods = build_pods(200, 50_000, rng, gpu_frac=0.05, zone_frac=0.2,
                                taint_frac=0.1)
-    p50, _solve_p50 = run_config("50k-burst", headline_pods, 600, iters=5)
+    p50, _solve_p50 = run_config("50k-burst", headline_pods, 600, iters=9)
 
     baseline_ms = 200.0
     print(json.dumps({

@@ -375,6 +375,6 @@ def termination_duration() -> Histogram:
     """drain start → instance gone (reference
     karpenter_nodes_termination_time_seconds family)."""
     return REGISTRY.histogram(
-        "karpenter_nodes_termination_duration_seconds",
+        "karpenter_nodes_termination_time_seconds",
         "Time from drain request to instance termination.",
         buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800))
