@@ -250,7 +250,8 @@ class DisruptionController:
                               exclude_nodes=exclude_names))
         problem = tensorize(pods, catalog, pools)
         node_list, alloc, used, compat = self.cluster.tensorize_nodes(
-            problem.class_reps, problem.axes, exclude=exclude_names)
+            problem.class_reps, problem.axes, exclude=exclude_names,
+            scales=problem.scales)
         if len(node_list) == 0 and problem.num_options == 0:
             result = PackingResult(
                 nodes=[], unschedulable=list(range(len(pods))),

@@ -184,7 +184,7 @@ class Provisioner:
             problem = tensorize(lowered, catalog, pools)
             if schedule_on_existing and self.cluster.nodes:
                 node_list, alloc, used, compat = self.cluster.tensorize_nodes(
-                    problem.class_reps, problem.axes)
+                    problem.class_reps, problem.axes, scales=problem.scales)
                 solve = self._pick_solver(problem, n_existing=len(node_list))
                 result = solve(problem, max_nodes=self.max_nodes_per_round,
                                existing_alloc=alloc, existing_used=used,

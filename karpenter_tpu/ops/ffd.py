@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..api.resources import DEFAULT_SCALES, ResourceList
+from ..api.resources import ResourceList
 from .tensorize import LaunchOption, Problem, pad_to
 
 NO_ASSIGNMENT = -1
@@ -265,7 +265,7 @@ def decode_assignment(problem: Problem, assignment: np.ndarray,
         nodes.append(NodeDecision(
             option=option,
             pod_indices=pods_on_node,
-            used=ResourceList.from_vector(used_vec, problem.axes, DEFAULT_SCALES),
+            used=ResourceList.from_vector(used_vec, problem.axes, problem.scales),
             alternatives=[problem.options[a] for a in alt_ids],
         ))
     return PackingResult(nodes=nodes, unschedulable=unschedulable,

@@ -74,6 +74,10 @@ class Problem:
     # capacity-type vocabulary; on-demand=0, spot=1 in the standard catalog)
     zones: List[str] = field(default_factory=list)
     pods: List[Pod] = field(default_factory=list)
+    # per-axis quantity scales the dense arrays were lowered with (byte axes
+    # divide to MiB so int32 kernel math can't overflow); decode must invert
+    # with THESE, not DEFAULT_SCALES — extra axes may carry their own scale
+    scales: Mapping[str, float] = field(default_factory=lambda: DEFAULT_SCALES)
 
     @property
     def num_classes(self) -> int:
@@ -276,18 +280,20 @@ class _CatalogSide:
     per-option effective zone/captype sets are the singletons {o.zone} /
     {o.capacity_type}."""
 
-    __slots__ = ("catalog", "nodepools", "options", "option_alloc",
+    __slots__ = ("scales", "catalog", "nodepools", "options", "option_alloc",
                  "option_price", "option_zone", "option_captype",
                  "option_rank", "option_pool", "option_group", "zones",
                  "captypes", "groups", "pool_taints", "rest_mask_memo",
                  "compat_memo", "axes")
 
     def __init__(self, catalog: Sequence[InstanceType],
-                 nodepools: Sequence[NodePool], axes: Tuple[str, ...]):
+                 nodepools: Sequence[NodePool], axes: Tuple[str, ...],
+                 scales: Optional[Mapping[str, float]] = None):
         # strong refs keep the fingerprint's id()s stable for the cache's life
         self.catalog = list(catalog)
         self.nodepools = list(nodepools)
         self.axes = axes
+        self.scales = DEFAULT_SCALES if scales is None else scales
         options = build_options(catalog, nodepools)
         self.options = options
         O, R = len(options), len(axes)
@@ -311,7 +317,7 @@ class _CatalogSide:
             vec = alloc_by_type.get(opt.type_index)
             if vec is None:
                 vec = alloc_by_type[opt.type_index] = \
-                    it.allocatable.to_vector(axes, DEFAULT_SCALES)
+                    it.allocatable.to_vector(axes, self.scales)
             self.option_alloc[j] = vec
             self.option_price[j] = opt.price
             self.option_zone[j] = zone_ids[opt.zone]
@@ -396,7 +402,8 @@ _CATSIDE_MAX = 8
 
 def _catside_fingerprint(catalog: Sequence[InstanceType],
                          nodepools: Sequence[NodePool],
-                         axes: Tuple[str, ...]) -> tuple:
+                         axes: Tuple[str, ...],
+                         scales: Optional[Mapping[str, float]] = None) -> tuple:
     # requirements are keyed by an int hash over EVERY Requirement field
     # (not Requirement.__hash__, which omits min_values) — full content
     # tuples would triple the cost of this hot-path fingerprint, and a
@@ -415,18 +422,21 @@ def _catside_fingerprint(catalog: Sequence[InstanceType],
          tuple(repr(t) for t in p.template.taints),
          tuple(sorted((k, repr(r)) for k, r in p.template.requirements.items())))
         for p in nodepools)
-    return (cat_sig, pool_sig, axes)
+    scale_sig = (None if scales is None else
+                 tuple(sorted((k, float(v)) for k, v in scales.items())))
+    return (cat_sig, pool_sig, axes, scale_sig)
 
 
 def catalog_side(catalog: Sequence[InstanceType],
                  nodepools: Sequence[NodePool],
-                 axes: Tuple[str, ...] = DEFAULT_AXES) -> _CatalogSide:
-    key = _catside_fingerprint(catalog, nodepools, axes)
+                 axes: Tuple[str, ...] = DEFAULT_AXES,
+                 scales: Optional[Mapping[str, float]] = None) -> _CatalogSide:
+    key = _catside_fingerprint(catalog, nodepools, axes, scales)
     side = _CATSIDE_CACHE.get(key)
     if side is None:
         if len(_CATSIDE_CACHE) >= _CATSIDE_MAX:
             _CATSIDE_CACHE.pop(next(iter(_CATSIDE_CACHE)))
-        side = _CatalogSide(catalog, nodepools, axes)
+        side = _CatalogSide(catalog, nodepools, axes, scales)
     else:
         _CATSIDE_CACHE.pop(key)  # re-insert: eviction order becomes LRU
     _CATSIDE_CACHE[key] = side
@@ -437,9 +447,6 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
               nodepools: Sequence[NodePool],
               axes: Tuple[str, ...] = DEFAULT_AXES) -> Problem:
     """Lower a scheduling round to dense arrays."""
-    side = catalog_side(catalog, nodepools, axes)
-    O, R = len(side.options), len(axes)
-
     # pod equivalence classes, grouped in numpy over interned class ids —
     # one attribute read per pod instead of a dict-build round trip; class
     # order stays first-appearance (the old dict semantics) so tie-breaks
@@ -464,13 +471,48 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
         reps, members = [], []
         counts = np.zeros(0, np.int64)
 
+    # requested resources outside the configured axes become extra axes, so
+    # the packer accounts for them exactly instead of silently ignoring
+    # them (the reference compares EVERY requested resource,
+    # /root/reference/pkg/cloudprovider/cloudprovider.go:264 resources.Fits
+    # — a pod asking for example.com/fpga must land only on types
+    # advertising it, or go unschedulable). Scanning class reps, not pods:
+    # identical requests are part of the class key.
+    extra = sorted({k for rep in reps for k, v in rep.requests.items()
+                    if v and k not in axes})
+    scales = DEFAULT_SCALES
+    if extra:
+        axes = tuple(axes) + tuple(extra)
+        # extra axes with byte-sized magnitudes must scale down or they
+        # overflow the kernels' int32 lowering (2^31 ≈ 2GiB): hugepages-*
+        # are bytes by the k8s spec and get the MEMORY convention (MiB);
+        # anything else scales by the SMALLEST power of two that brings its
+        # max observed quantity under 2^30 — count-valued resources with
+        # large node capacity keep (most of) their granularity instead of
+        # being flattened 2^20x (request ceil(1/2^20)=1 would collapse a
+        # node's capacity to alloc/2^20 and over-provision wildly)
+        scales = dict(DEFAULT_SCALES)
+        for k in extra:
+            if k.startswith("hugepages-"):
+                scales[k] = float(2**20)
+                continue
+            big = max((float(rep.requests.get(k, 0)) for rep in reps),
+                      default=0.0)
+            big = max(big, max((float(it.allocatable.get(k, 0))
+                                for it in catalog), default=0.0))
+            if big >= 2.0**30:
+                scales[k] = 2.0 ** math.ceil(math.log2(big) - 30)
+
+    side = catalog_side(catalog, nodepools, axes, scales)
+    O, R = len(side.options), len(axes)
+
     C = len(reps)
     class_requests = np.zeros((C, R), np.float32)
     class_compat = np.zeros((C, O), bool)
     for ci, rep in enumerate(reps):
         req = ResourceList(rep.requests)
         req[PODS] = req.get(PODS, 0) + 1  # every pod consumes one pod slot
-        class_requests[ci] = req.to_vector(axes, DEFAULT_SCALES, round_up=True)
+        class_requests[ci] = req.to_vector(axes, scales, round_up=True)
         class_compat[ci] = side.compat_row(rep)
 
     return Problem(
@@ -488,6 +530,7 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
         option_captype=side.option_captype,
         zones=side.zones,
         pods=list(pods),
+        scales=scales,
     )
 
 

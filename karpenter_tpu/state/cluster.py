@@ -218,7 +218,8 @@ class Cluster:
     def tensorize_nodes(self, pod_classes: Sequence[Pod],
                         axes: Tuple[str, ...] = DEFAULT_AXES,
                         exclude: Sequence[str] = (),
-                        nodes: Optional[Sequence[Node]] = None):
+                        nodes: Optional[Sequence[Node]] = None,
+                        scales=None):
         """Lower live nodes to pre-opened packing slots.
 
         Returns (node_list, alloc E×R, used E×R, compat C×E) where compat is
@@ -227,15 +228,17 @@ class Cluster:
         this node were gone" (SURVEY.md §7.6)."""
         node_list = [n for n in (nodes if nodes is not None else self.nodes.values())
                      if n.name not in exclude and not n.marked_for_deletion]
+        if scales is None:
+            scales = DEFAULT_SCALES
         E, R, C = len(node_list), len(axes), len(pod_classes)
         alloc = np.zeros((E, R), np.float32)
         used = np.zeros((E, R), np.float32)
         compat = np.zeros((C, E), bool)
         for e, n in enumerate(node_list):
-            alloc[e] = n.allocatable.to_vector(axes, DEFAULT_SCALES)
+            alloc[e] = n.allocatable.to_vector(axes, scales)
             req = n.requested()
             req[PODS] = len(n.pods)
-            used[e] = req.to_vector(axes, DEFAULT_SCALES, round_up=True)
+            used[e] = req.to_vector(axes, scales, round_up=True)
             node_labels = dict(n.labels)
             # hostname defaults to the node name so hostname-NotIn lowerings
             # (anti-affinity) bind even for externally-seeded nodes that never

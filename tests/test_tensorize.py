@@ -238,3 +238,101 @@ class TestClassIdInterning:
         # members must partition the pod index space exactly
         all_members = sorted(int(i) for m in p2.class_members for i in m)
         assert all_members == list(range(18))
+
+
+class TestExtendedResourceAxes:
+    """Requests for resources outside DEFAULT_AXES must become solver axes
+    (reference resources.Fits compares every requested resource,
+    /root/reference/pkg/cloudprovider/cloudprovider.go:264) — before this,
+    an off-axis request was silently dropped and the packer placed the pod
+    on capacity that lacked the resource, failing only at launch."""
+
+    def test_off_axis_request_extends_axes(self):
+        pod = Pod(requests=ResourceList({CPU: 1000, "example.com/fpga": 2}))
+        prob = tensorize([pod], small_catalog(), [NodePool()])
+        assert "example.com/fpga" in prob.axes
+        ax = prob.axes.index("example.com/fpga")
+        assert prob.class_requests[0, ax] == 2
+        # no catalog type advertises the resource -> alloc column all zero
+        assert (prob.option_alloc[:, ax] == 0).all()
+
+    def test_unschedulable_when_no_type_advertises(self):
+        from karpenter_tpu.ops.classpack import solve_classpack
+        pods = [Pod(requests=ResourceList({CPU: 1000, "example.com/fpga": 1}))]
+        prob = tensorize(pods, small_catalog(), [NodePool()])
+        r = solve_classpack(prob)
+        assert len(r.unschedulable) == 1 and not r.nodes
+
+    def test_packs_only_on_advertising_types_with_capacity_accounting(self):
+        from karpenter_tpu.ops.classpack import solve_classpack
+        fpga = make_type("f.large", 16, 64, 2.0)
+        fpga.allocatable["example.com/fpga"] = 4
+        fpga.capacity["example.com/fpga"] = 4
+        cat = small_catalog() + [fpga]
+        # 3 pods x 2 fpga each: exactly 2 fit per node -> 2 nodes, never 1
+        pods = [Pod(requests=ResourceList({CPU: 100, "example.com/fpga": 2}))
+                for _ in range(3)]
+        prob = tensorize(pods, cat, [NodePool()])
+        r = solve_classpack(prob)
+        assert not r.unschedulable
+        assert all(n.option.instance_type == "f.large" for n in r.nodes)
+        assert len(r.nodes) == 2
+
+    def test_default_axes_unchanged_without_extended_requests(self):
+        prob = tensorize([cpu_pod()], small_catalog(), [NodePool()])
+        from karpenter_tpu.api.resources import DEFAULT_AXES
+        assert prob.axes == DEFAULT_AXES
+
+    def test_byte_valued_extra_axis_scales_no_overflow(self):
+        """hugepages-1Gi requests are byte quantities: without MiB scaling
+        they overflow the kernels' int32 lowering (review finding r4) and
+        the pod lands on capacity without the resource."""
+        from karpenter_tpu.ops.classpack import solve_classpack
+        huge = make_type("h.large", 16, 64, 3.0)
+        huge.allocatable["hugepages-1Gi"] = 8 * 2**30
+        huge.capacity["hugepages-1Gi"] = 8 * 2**30
+        cat = small_catalog() + [huge]
+        pods = [Pod(requests=ResourceList(
+            {CPU: 100, "hugepages-1Gi": 4 * 2**30})) for _ in range(3)]
+        prob = tensorize(pods, cat, [NodePool()])
+        ax = prob.axes.index("hugepages-1Gi")
+        assert prob.scales["hugepages-1Gi"] == 2**20
+        assert prob.class_requests[0, ax] == 4096          # MiB, not bytes
+        assert prob.option_alloc[:, ax].max() == 8192
+        r = solve_classpack(prob)
+        assert not r.unschedulable
+        assert all(n.option.instance_type == "h.large" for n in r.nodes)
+        assert len(r.nodes) == 2                            # 2 per node
+        # decode round-trips the scaled axis back to bytes
+        full = max(r.nodes, key=lambda n: len(n.pod_indices))
+        assert full.used["hugepages-1Gi"] == 8 * 2**30
+
+    def test_large_unnamed_byte_resource_scales_by_magnitude(self):
+        big = make_type("b.large", 16, 64, 3.0)
+        big.allocatable["example.com/vram"] = 16 * 2**30
+        big.capacity["example.com/vram"] = 16 * 2**30
+        cat = small_catalog() + [big]
+        pod = Pod(requests=ResourceList({CPU: 100, "example.com/vram": 2**30}))
+        prob = tensorize([pod], cat, [NodePool()])
+        ax = prob.axes.index("example.com/vram")
+        # minimal power of two bringing 16GiB under 2^30: 2^4
+        assert prob.scales["example.com/vram"] == 2**4
+        assert prob.class_requests[0, ax] == 2**26
+        assert prob.option_alloc[:, ax].max() == 2**30
+
+    def test_count_valued_resource_with_large_capacity_keeps_granularity(self):
+        """A count-style resource with huge node capacity must not be
+        flattened to MiB units (review finding r4): requests of 1 should
+        not collapse capacity by 2^20."""
+        from karpenter_tpu.ops.classpack import solve_classpack
+        big = make_type("q.large", 64, 256, 3.0)
+        big.allocatable["example.com/tokens"] = 2**26
+        big.capacity["example.com/tokens"] = 2**26
+        cat = [big]
+        pods = [Pod(requests=ResourceList({CPU: 10, "example.com/tokens": 1}))
+                for _ in range(100)]
+        prob = tensorize(pods, cat, [NodePool()])
+        assert prob.scales.get("example.com/tokens", 1.0) == 1.0
+        r = solve_classpack(prob)
+        assert not r.unschedulable
+        assert len(r.nodes) == 1  # all 100 fit one node, not 64-per-node
