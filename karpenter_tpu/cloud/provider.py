@@ -209,6 +209,21 @@ class CloudProvider:
 
     # ---- actuation ----
     def create(self, claim: NodeClaim) -> NodeClaim:
+        t0 = time.perf_counter()
+        try:
+            out = self._create(claim)
+            # claim creation and instance launch coincide at this seam, and
+            # EVERY launch path (provisioner, disruption replacement,
+            # lifecycle) funnels through it — counting here keeps
+            # launched == created >= registered
+            metrics.nodeclaims_created().inc({"nodepool": claim.nodepool or ""})
+            metrics.nodeclaims_launched().inc({"nodepool": claim.nodepool or ""})
+            return out
+        finally:
+            metrics.cloudprovider_duration().observe(
+                time.perf_counter() - t0, {"method": "create"})
+
+    def _create(self, claim: NodeClaim) -> NodeClaim:
         """Launch capacity for a NodeClaim
         (/root/reference/pkg/cloudprovider/cloudprovider.go:92-118 →
         /root/reference/pkg/providers/instance/instance.go:88-105)."""
@@ -372,6 +387,14 @@ class CloudProvider:
         return labels
 
     def delete(self, claim: NodeClaim) -> None:
+        t0 = time.perf_counter()
+        try:
+            return self._delete(claim)
+        finally:
+            metrics.cloudprovider_duration().observe(
+                time.perf_counter() - t0, {"method": "delete"})
+
+    def _delete(self, claim: NodeClaim) -> None:
         if not claim.provider_id:
             return
         done = self.cloud.terminate_instances([claim.provider_id])
@@ -387,6 +410,14 @@ class CloudProvider:
         return self._instance_to_claim(inst)
 
     def list(self) -> List[NodeClaim]:
+        t0 = time.perf_counter()
+        try:
+            return self._list()
+        finally:
+            metrics.cloudprovider_duration().observe(
+                time.perf_counter() - t0, {"method": "list"})
+
+    def _list(self) -> List[NodeClaim]:
         """All cluster-owned instances as NodeClaims (GC ground truth,
         /root/reference/pkg/controllers/nodeclaim/garbagecollection/controller.go:57-91)."""
         out = []

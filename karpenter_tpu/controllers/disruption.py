@@ -289,9 +289,20 @@ class DisruptionController:
         if not self.drift_enabled:
             return []
         out = []
+        counted = self.__dict__.setdefault("_drift_counted", set())
         for c in cands:
             if c.claim is not None and self.provider.is_drifted(c.claim, c.pool):
                 out.append(c)
+                # transition counter: first detection only, not every tick
+                # (reference karpenter_nodeclaims_drifted)
+                if c.name not in counted:
+                    counted.add(c.name)
+                    metrics.nodeclaims_drifted().inc(
+                        {"nodepool": c.node.nodepool or ""})
+        # prune only nodes GONE from the cluster: a drifted node that
+        # transiently leaves candidacy (nomination, PDB, truncation) stays
+        # counted so its return doesn't inflate the transition counter
+        counted.intersection_update(set(self.cluster.nodes))
         return out
 
     def find_empty(self, cands: List[Candidate]) -> List[Candidate]:
@@ -563,6 +574,12 @@ class DisruptionController:
                 out.deleted.extend(tres.terminated)
                 if tres.errors:
                     out.error = "; ".join(tres.errors)
+                else:
+                    # count only ACTUAL disruptions — a failed drain retries
+                    # next tick and must not double-count
+                    metrics.nodeclaims_disrupted().inc(
+                        {"type": action.reason,
+                         "nodepool": c.node.nodepool or ""})
                 continue
             # daemonset pods die with their node — they must NOT be requeued
             # as pending (a fresh node would be provisioned just for them)
@@ -588,6 +605,8 @@ class DisruptionController:
                 self.cluster.nodeclaims.pop(c.claim.name, None)
             self.cluster.remove_node(c.name)
             out.deleted.append(c.name)
+            metrics.nodeclaims_disrupted().inc(
+                {"type": action.reason, "nodepool": c.node.nodepool or ""})
         log.info("disruption %s: deleted %s, launched %s", action.name,
                  out.deleted, [c.name for c in out.launched])
         return out

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List
 
 from ..cloud.provider import CloudProvider
+from ..utils import metrics
 from ..state.cluster import Cluster
 
 log = logging.getLogger("karpenter_tpu.gc")
@@ -64,6 +65,7 @@ class GarbageCollectionController:
             if node is not None:
                 self.cluster.remove_node(node.name)
             out.leaked_instances.append(claim.provider_id)
+            metrics.consistency_errors().inc({"check": "leaked_instance"})
             log.info("GC: terminated leaked instance %s", claim.provider_id)
 
         # orphaned nodes: node object outlived its instance (e.g. reclaimed
@@ -75,6 +77,7 @@ class GarbageCollectionController:
                     self.cluster.nodeclaims.pop(claim.name, None)
                 self.cluster.remove_node(node.name)
                 out.orphaned_nodes.append(node.name)
+                metrics.consistency_errors().inc({"check": "orphaned_node"})
                 log.info("GC: removed orphaned node %s", node.name)
         return out
 

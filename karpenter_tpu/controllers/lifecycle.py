@@ -150,7 +150,15 @@ class LifecycleController:
         if claim.registered_at:
             metrics.nodeclaim_initialization_duration().observe(
                 max(0.0, claim.initialized_at - claim.registered_at))
+        metrics.nodeclaims_initialized().inc({"nodepool": claim.nodepool})
         node.labels[wk.NODE_INITIALIZED] = "true"
+        # pods that bound while the node was still coming up reach
+        # "running on a ready node" now (karpenter_pods_startup_time_seconds)
+        for p_ in node.pods:
+            if not p_.__dict__.get("_startup_observed"):
+                p_.__dict__["_startup_observed"] = True
+                metrics.pods_startup_time().observe(
+                    max(0.0, self.clock() - p_.created_at))
         out.initialized.append(node.name)
         self.recorder.publish(Event("Node", node.name, "Initialized", ""))
 

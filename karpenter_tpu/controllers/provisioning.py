@@ -320,6 +320,4 @@ class Provisioner:
         # metrics.md:146-149); the unschedulable gauge is set once per
         # provision() from the aggregated result, not per sub-round
         metrics.scheduling_duration().observe(out.solve_seconds)
-        for claim in out.launched:
-            metrics.nodeclaims_created().inc({"nodepool": claim.nodepool})
         return out

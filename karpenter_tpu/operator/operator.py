@@ -41,6 +41,7 @@ from ..providers.securitygroup import SecurityGroupProvider
 from ..providers.subnet import SubnetProvider
 from ..providers.version import VersionProvider
 from ..state.cluster import Cluster
+from ..utils import metrics
 from ..utils.events import Recorder
 from .options import Options
 
@@ -111,6 +112,11 @@ class Operator:
             clock=clock)
 
         self.cluster = Cluster(clock=clock)
+        # scrape-time state gauges: per-node allocatable/requests, pod phases
+        # (reference karpenter_nodes_allocatable / _total_pod_requests /
+        # karpenter_pods_state) — refreshed on /metrics, stale series dropped
+        metrics.REGISTRY.add_collector(
+            metrics.make_cluster_collector(self.cluster))
         self.node_classes: Dict[str, NodeClass] = {"default": NodeClass()}
         self.nodepools: Dict[str, NodePool] = {"default": NodePool()}
         self.cloud_provider = CloudProvider(

@@ -77,6 +77,17 @@ class Cluster:
             # (karpenter_pods_bound_duration_seconds)
             metrics.pods_bound_duration().observe(
                 max(0.0, self.clock() - pod.created_at))
+            # pod "startup": running on a READY node.  Bound to an
+            # already-initialized node -> now; else the lifecycle
+            # controller observes it when initialization completes.  Once
+            # per pod LIFETIME (flag survives requeue): an evicted pod
+            # rebinding hours later would otherwise log its age, not its
+            # startup latency.
+            if (node.labels.get(wk.NODE_INITIALIZED) == "true"
+                    and not pod.__dict__.get("_startup_observed")):
+                pod.__dict__["_startup_observed"] = True
+                metrics.pods_startup_time().observe(
+                    max(0.0, self.clock() - pod.created_at))
 
     def unbind_pod(self, pod: Pod):
         if pod.node_name and pod.node_name in self.nodes:
@@ -101,6 +112,7 @@ class Cluster:
     def remove_node(self, name: str) -> Optional[Node]:
         node = self.nodes.pop(name, None)
         if node:
+            metrics.nodes_terminated().inc({"nodepool": node.nodepool or ""})
             for p in node.pods:
                 p.node_name = ""
                 # evicted pods with owners get recreated as pending; ownerless
@@ -136,6 +148,10 @@ class Cluster:
             if initialized:
                 metrics.nodeclaim_initialization_duration().observe(
                     max(0.0, claim.initialized_at - claim.registered_at))
+                metrics.nodeclaims_initialized().inc(
+                    {"nodepool": claim.nodepool})
+            metrics.nodeclaims_registered().inc({"nodepool": claim.nodepool})
+            metrics.nodes_created().inc({"nodepool": claim.nodepool})
         self.nodeclaims[claim.name] = claim
         node = Node(
             name=f"node-{next(_names):06d}",
@@ -155,6 +171,8 @@ class Cluster:
             nominated_until=self.clock() + NOMINATION_WINDOW_S,
         )
         node.labels.setdefault(wk.HOSTNAME, node.name)
+        if initialized:
+            node.labels[wk.NODE_INITIALIZED] = "true"
         return self.add_node(node)
 
     def node_for_provider_id(self, provider_id: str) -> Optional[Node]:

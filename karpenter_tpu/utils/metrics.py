@@ -155,6 +155,15 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
+        self._collectors: list = []
+
+    def add_collector(self, fn) -> None:
+        """Register a scrape-time refresher: called (outside the lock) at the
+        top of expose().  Used for gauges derived from live state — per-node
+        allocatable, pod phase counts — where eager per-event updates would
+        be wasteful and stale-series cleanup is easiest done in one sweep."""
+        with self._lock:
+            self._collectors.append(fn)
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
@@ -186,9 +195,14 @@ class Registry:
         registry between suites, pkg/test/environment.go:72-176)."""
         with self._lock:
             self._metrics.clear()
+            self._collectors.clear()
 
     def expose(self) -> str:
         """Prometheus text exposition format."""
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            fn()
         lines = []
         with self._lock:
             metrics = list(self._metrics.values())
@@ -378,3 +392,132 @@ def termination_duration() -> Histogram:
         "karpenter_nodes_termination_time_seconds",
         "Time from drain request to instance termination.",
         buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800))
+
+
+def nodeclaims_launched() -> Counter:
+    """Cloud instance actually launched for a claim (reference
+    karpenter_nodeclaims_launched; created counts the claim object)."""
+    return REGISTRY.counter(
+        "karpenter_nodeclaims_launched",
+        "NodeClaims whose instance launched.", labels=("nodepool",))
+
+
+def nodeclaims_registered() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_nodeclaims_registered",
+        "NodeClaims whose node joined the cluster.", labels=("nodepool",))
+
+
+def nodeclaims_initialized() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_nodeclaims_initialized",
+        "NodeClaims whose node passed initialization.", labels=("nodepool",))
+
+
+def nodeclaims_disrupted() -> Counter:
+    """Per disruption-method claim churn (reference
+    karpenter_nodeclaims_disrupted with type label)."""
+    return REGISTRY.counter(
+        "karpenter_nodeclaims_disrupted",
+        "NodeClaims disrupted, by method.", labels=("type", "nodepool"))
+
+
+def nodeclaims_drifted() -> Counter:
+    """First-detection drift transitions, not per-tick re-observations."""
+    return REGISTRY.counter(
+        "karpenter_nodeclaims_drifted",
+        "NodeClaims that drifted from their nodepool/nodeclass spec.",
+        labels=("nodepool",))
+
+
+def nodes_created() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_nodes_created",
+        "Nodes created from NodeClaims.", labels=("nodepool",))
+
+
+def nodes_terminated() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_nodes_terminated",
+        "Nodes removed from the cluster.", labels=("nodepool",))
+
+
+def consistency_errors() -> Counter:
+    """Cloud/cluster state mismatches the GC repaired (reference
+    karpenter_consistency_errors): leaked instances, orphaned nodes."""
+    return REGISTRY.counter(
+        "karpenter_consistency_errors",
+        "State inconsistencies detected.", labels=("check",))
+
+
+def cloudprovider_duration() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_cloudprovider_duration_seconds",
+        "Cloud API call latency by method.", labels=("method",),
+        buckets=(.001, .005, .01, .05, .1, .5, 1, 5, 15, 60))
+
+
+def pods_startup_time() -> Histogram:
+    """Pod arrival → running on an initialized node (reference
+    karpenter_pods_startup_time_seconds)."""
+    return REGISTRY.histogram(
+        "karpenter_pods_startup_time_seconds",
+        "Time from pod arrival to running on a ready node.",
+        buckets=(1, 5, 15, 30, 60, 120, 300, 600, 900))
+
+
+def pods_state() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_pods_state",
+        "Pods known to the scheduler, by phase.", labels=("phase",))
+
+
+def nodes_allocatable() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_nodes_allocatable",
+        "Allocatable capacity per node.",
+        labels=("node_name", "nodepool", "resource_type"))
+
+
+def nodes_pod_requests() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_nodes_total_pod_requests",
+        "Sum of scheduled pod requests per node.",
+        labels=("node_name", "nodepool", "resource_type"))
+
+
+def make_cluster_collector(cluster):
+    """Scrape-time collector for per-node and pod-phase gauges.  Refreshes
+    karpenter_nodes_allocatable / karpenter_nodes_total_pod_requests /
+    karpenter_pods_state from live cluster state and deletes series for
+    nodes that have since terminated."""
+    prev_keys: set = set()
+
+    def collect():
+        nonlocal prev_keys
+        alloc_g, req_g, state_g = (nodes_allocatable(), nodes_pod_requests(),
+                                   pods_state())
+        cur: set = set()
+        pending = bound = 0
+        for p in cluster.pods.values():
+            if p.node_name:
+                bound += 1
+            else:
+                pending += 1
+        state_g.set(pending, {"phase": "pending"})
+        state_g.set(bound, {"phase": "running"})
+        for n in list(cluster.nodes.values()):
+            base = {"node_name": n.name, "nodepool": n.nodepool or ""}
+            for res, qty in n.allocatable.items():
+                alloc_g.set(qty, {**base, "resource_type": res})
+                cur.add(("a", n.name, n.nodepool or "", res))
+            for res, qty in n.requested().items():
+                req_g.set(qty, {**base, "resource_type": res})
+                cur.add(("r", n.name, n.nodepool or "", res))
+        for kind, name, pool, res in prev_keys - cur:
+            g = alloc_g if kind == "a" else req_g
+            g.delete({"node_name": name, "nodepool": pool,
+                      "resource_type": res})
+        prev_keys = cur
+
+    return collect

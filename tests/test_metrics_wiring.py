@@ -125,3 +125,135 @@ def test_lifecycle_and_termination_durations_emit():
     term.reconcile()
     hist = metrics.termination_duration()
     assert hist.count() == 1 and abs(hist.sum() - 3.0) < 1e-6
+
+
+def test_nodeclaim_state_counters_emit():
+    """launched/registered/initialized counters track the claim lifecycle;
+    nodes created/terminated counters track node churn."""
+    pools = [NodePool()]
+    clock, cluster, prov, provider = env(pools)
+    cluster.add_pods([cpu_pod(cpu_m=400)])
+    prov.provision()
+    lab = {"nodepool": "default"}
+    assert metrics.nodeclaims_created().value(lab) == 1
+    assert metrics.nodeclaims_launched().value(lab) == 1
+    assert metrics.nodeclaims_registered().value(lab) == 1
+    assert metrics.nodeclaims_initialized().value(lab) == 1
+    assert metrics.nodes_created().value(lab) == 1
+    node = next(iter(cluster.nodes.values()))
+    cluster.remove_node(node.name)
+    assert metrics.nodes_terminated().value(lab) == 1
+
+
+def test_disrupted_and_drifted_counters_emit_once():
+    from karpenter_tpu.api.objects import Disruption
+    pools = [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized"))]
+    clock, cluster, prov, provider = env(pools)
+    cluster.add_pods([cpu_pod(cpu_m=400)])
+    prov.provision()
+    cluster.add_pods([cpu_pod(cpu_m=1800, mem_mib=3000)])
+    prov.provision()
+    ctrl = DisruptionController(provider, cluster, pools, clock=clock,
+                                stabilization_s=0.0)
+    res = ctrl.reconcile()
+    assert res.action is not None and res.deleted
+    c = metrics.nodeclaims_disrupted()
+    assert c.value({"type": res.action.reason, "nodepool": "default"}) >= 1
+    # drift transition counts once, not per tick
+    cands = ctrl.candidates()
+    if cands:
+        claim = cands[0].claim
+        claim.nodeclass_hash = "stale"
+        ctrl.find_drifted(ctrl.candidates())
+        ctrl.find_drifted(ctrl.candidates())
+        assert metrics.nodeclaims_drifted().value({"nodepool": "default"}) <= 1
+
+
+def test_cloudprovider_duration_and_consistency_counters():
+    from karpenter_tpu.controllers.garbagecollection import (
+        GarbageCollectionController)
+    pools = [NodePool()]
+    clock, cluster, prov, provider = env(pools)
+    cluster.add_pods([cpu_pod(cpu_m=400)])
+    prov.provision()
+    hist = metrics.cloudprovider_duration()
+    assert hist.count({"method": "create"}) >= 1
+    provider.list()
+    assert hist.count({"method": "list"}) >= 1
+    # leaked instance: cloud capacity with no matching claim -> consistency
+    claim = next(iter(cluster.nodeclaims.values()))
+    cluster.nodeclaims.pop(claim.name)
+    node = cluster.node_for_provider_id(claim.provider_id)
+    if node:
+        cluster.remove_node(node.name)
+    clock.t += 3600
+    gc = GarbageCollectionController(provider, cluster, clock=clock)
+    gc.reconcile()
+    assert metrics.consistency_errors().value({"check": "leaked_instance"}) >= 1
+
+
+def test_cluster_collector_refreshes_and_drops_stale_series():
+    pools = [NodePool()]
+    clock, cluster, prov, provider = env(pools)
+    metrics.REGISTRY.add_collector(metrics.make_cluster_collector(cluster))
+    cluster.add_pods([cpu_pod(cpu_m=400), cpu_pod(cpu_m=400)])
+    prov.provision()
+    text = metrics.REGISTRY.expose()
+    assert "karpenter_nodes_allocatable" in text
+    assert "karpenter_nodes_total_pod_requests" in text
+    assert 'karpenter_pods_state{phase="running"} 2' in text
+    node = next(iter(cluster.nodes.values()))
+    series = metrics.nodes_allocatable().samples()
+    assert any(("node_name", node.name) in key for _, key, _ in series)
+    # node terminates -> its per-node series disappear on next scrape
+    for p in list(node.pods):
+        cluster.delete_pod(p)
+    cluster.remove_node(node.name)
+    metrics.REGISTRY.expose()
+    series = metrics.nodes_allocatable().samples()
+    assert not any(("node_name", node.name) in key for _, key, _ in series)
+
+
+def test_pods_startup_time_sync_and_async_paths():
+    from karpenter_tpu.controllers.lifecycle import LifecycleController
+    from karpenter_tpu.api.objects import NodeClaim
+    pools = [NodePool()]
+    clock, cluster, prov, provider = env(pools)
+    # sync path: bind to an initialized node observes immediately
+    cluster.add_pods([cpu_pod(cpu_m=400)])
+    clock.t += 1.0
+    prov.provision()
+    hist = metrics.pods_startup_time()
+    assert hist.count() == 1
+    assert abs(hist.sum() - 1.0) < 1e-6
+    # requeue guard: evicting and rebinding the same pod must NOT
+    # re-observe with its cumulative age
+    node = next(iter(cluster.nodes.values()))
+    pod = node.pods[0]
+    cluster.unbind_pod(pod)
+    clock.t += 3600.0
+    cluster.bind_pod(pod, node.name)
+    assert hist.count() == 1
+    # async path: pod bound while the node is still coming up is observed
+    # when the lifecycle controller completes initialization.  A startup
+    # taint keeps the node un-ready for one extra pass.
+    from karpenter_tpu.api.objects import NodePoolTemplate
+    from karpenter_tpu.api.taints import Taint
+    st = Taint("example.com/startup", "NoSchedule")
+    spool = NodePool(template=NodePoolTemplate(startup_taints=[st]))
+    lc = LifecycleController(provider, cluster,
+                             nodepools={"default": spool},
+                             clock=clock, join_delay=5.0)
+    claim = provider.create(NodeClaim(nodepool="default", taints=[st]))
+    lc.track(claim)
+    clock.t += 6.0
+    lc.reconcile()                       # registers; clears startup taint
+    late_node = cluster.node_for_provider_id(claim.provider_id)
+    late_pod = cluster.add_pod(cpu_pod(cpu_m=100))
+    cluster.bind_pod(late_pod, late_node.name)
+    assert hist.count() == 1             # node not ready: nothing observed
+    clock.t += 4.0
+    lc.reconcile()                       # initializes -> observes late_pod
+    assert hist.count() == 2
+    assert abs(hist.sum() - 1.0 - 4.0) < 1e-6
