@@ -285,7 +285,7 @@ def _device_podside(req_p: np.ndarray, cnt_p: np.ndarray,
     if hit is not None:
         return hit
     if len(_PODSIDE_CACHE) >= _PODSIDE_CACHE_MAX:
-        _PODSIDE_CACHE.pop(next(iter(_PODSIDE_CACHE)))
+        _PODSIDE_CACHE.pop(next(iter(_PODSIDE_CACHE)), None)
     val = (jnp.asarray(req_p), jnp.asarray(cnt_p), jnp.asarray(packed),
            jnp.asarray(cap_p))
     _PODSIDE_CACHE[key] = val
@@ -313,7 +313,7 @@ def _alt_memo_for(problem: Problem) -> dict:
             hit[1].clear()
         return hit[1]
     if len(_ALT_MEMO) >= _ALT_MEMO_MAX_CATALOGS:
-        _ALT_MEMO.pop(next(iter(_ALT_MEMO)))
+        _ALT_MEMO.pop(next(iter(_ALT_MEMO)), None)
     entries: dict = {}
     _ALT_MEMO[key] = (problem.options, entries)
     return entries
@@ -329,7 +329,7 @@ def _device_catalog(alloc: np.ndarray, price: np.ndarray, rank: np.ndarray):
     if hit is not None:
         return hit
     if len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
-        _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)))
+        _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)), None)
     val = (jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank))
     _CATALOG_CACHE[key] = val
     return val

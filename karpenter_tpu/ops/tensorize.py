@@ -435,7 +435,7 @@ def catalog_side(catalog: Sequence[InstanceType],
     side = _CATSIDE_CACHE.get(key)
     if side is None:
         if len(_CATSIDE_CACHE) >= _CATSIDE_MAX:
-            _CATSIDE_CACHE.pop(next(iter(_CATSIDE_CACHE)))
+            _CATSIDE_CACHE.pop(next(iter(_CATSIDE_CACHE)), None)
         side = _CatalogSide(catalog, nodepools, axes, scales)
     else:
         _CATSIDE_CACHE.pop(key)  # re-insert: eviction order becomes LRU
