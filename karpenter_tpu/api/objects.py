@@ -160,8 +160,29 @@ class KubeletConfiguration:
     kube_reserved: ResourceList = field(default_factory=ResourceList)
     system_reserved: ResourceList = field(default_factory=ResourceList)
     eviction_hard: ResourceList = field(default_factory=ResourceList)
-    cluster_dns: str = ""   # pins the node's DNS resolver (v4 or v6);
-                            # "" == use the cluster's discovered kube-dns
+    cluster_dns: tuple = ()  # node DNS resolver list (v4 or v6), primary
+                             # first; () == use the discovered kube-dns.
+                             # A bare string is accepted and normalized.
+
+    def __post_init__(self):
+        if isinstance(self.cluster_dns, str):
+            object.__setattr__(self, "cluster_dns",
+                               (self.cluster_dns,) if self.cluster_dns else ())
+        else:
+            object.__setattr__(self, "cluster_dns", tuple(self.cluster_dns))
+
+    def key(self) -> Optional[tuple]:
+        """Content key of the density-affecting fields; None when every
+        one is default (catalog needs no rebuild).  cluster_dns is
+        bootstrap-only — it never changes packing math."""
+        if (self.max_pods is None and not self.pods_per_core
+                and not self.kube_reserved and not self.system_reserved
+                and not self.eviction_hard):
+            return None
+        return (self.max_pods, self.pods_per_core,
+                tuple(sorted(self.kube_reserved.items())),
+                tuple(sorted(self.system_reserved.items())),
+                tuple(sorted(self.eviction_hard.items())))
 
 
 @dataclass

@@ -73,9 +73,30 @@ def nodepool_to_manifest(pool: NodePool) -> Dict:
                 "startupTaints": [taint_to_dict(x) for x in t.startup_taints],
             },
         },
+    }
+    kc = t.kubelet
+    if kc.key() is not None or kc.cluster_dns:
+        kd: Dict = {}
+        if kc.max_pods is not None:
+            kd["maxPods"] = kc.max_pods
+        if kc.pods_per_core:
+            kd["podsPerCore"] = kc.pods_per_core
+        if kc.kube_reserved:
+            kd["kubeReserved"] = {k: format_quantity(v, k)
+                                  for k, v in kc.kube_reserved.items()}
+        if kc.system_reserved:
+            kd["systemReserved"] = {k: format_quantity(v, k)
+                                    for k, v in kc.system_reserved.items()}
+        if kc.eviction_hard:
+            kd["evictionHard"] = {k: format_quantity(v, k)
+                                  for k, v in kc.eviction_hard.items()}
+        if kc.cluster_dns:
+            kd["clusterDNS"] = list(kc.cluster_dns)
+        spec["template"]["spec"]["kubelet"] = kd
+    spec.update({
         "disruption": _disruption_to_dict(pool.disruption),
         "weight": pool.weight,
-    }
+    })
     if pool.limits:
         spec["limits"] = {k: format_quantity(v, k)
                           for k, v in pool.limits.items()}
@@ -102,6 +123,21 @@ def _parse_duration(v) -> Optional[float]:
     return float(s)
 
 
+def _kubelet_from_dict(d: Dict) -> KubeletConfiguration:
+    """kubelet block per the reference NodePool CRD
+    (/root/reference/pkg/apis/crds/karpenter.sh_nodepools.yaml kubelet:
+    maxPods, podsPerCore, kubeReserved, systemReserved, evictionHard)."""
+    dns = d.get("clusterDNS") or []
+    return KubeletConfiguration(
+        max_pods=d.get("maxPods"),
+        pods_per_core=d.get("podsPerCore"),
+        kube_reserved=ResourceList.parse(d.get("kubeReserved", {}) or {}),
+        system_reserved=ResourceList.parse(d.get("systemReserved", {}) or {}),
+        eviction_hard=ResourceList.parse(d.get("evictionHard", {}) or {}),
+        cluster_dns=tuple(dns),
+    )
+
+
 def nodepool_from_manifest(m: Dict, validate: bool = True) -> NodePool:
     """Manifest → NodePool.  With ``validate`` (the default) the admission
     webhook semantics run on the result: defaulting then object validation
@@ -119,6 +155,7 @@ def nodepool_from_manifest(m: Dict, validate: bool = True) -> NodePool:
         startup_taints=[taint_from_dict(x)
                         for x in tspec.get("startupTaints", [])],
         node_class_ref=tspec.get("nodeClassRef", {}).get("name", "default"),
+        kubelet=_kubelet_from_dict(tspec.get("kubelet", {})),
     )
     d = spec.get("disruption", {})
     disruption = Disruption(
@@ -397,7 +434,39 @@ def crd_schemas() -> Dict[str, Dict]:
                     "type": "object",
                     "required": ["template"],
                     "properties": {
-                        "template": {"type": "object"},
+                        "template": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "properties": {
+                                        # pod-density / reserved overrides
+                                        # (reference NodePool CRD kubelet)
+                                        "kubelet": {
+                                            "type": "object",
+                                            "properties": {
+                                                "maxPods": {
+                                                    "type": "integer",
+                                                    "minimum": 1},
+                                                "podsPerCore": {
+                                                    "type": "integer",
+                                                    "minimum": 0},
+                                                "kubeReserved": {
+                                                    "type": "object"},
+                                                "systemReserved": {
+                                                    "type": "object"},
+                                                "evictionHard": {
+                                                    "type": "object"},
+                                                "clusterDNS": {
+                                                    "type": "array",
+                                                    "items": {
+                                                        "type": "string"}},
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
                         "weight": {"type": "integer", "minimum": 0,
                                    "maximum": 100},
                         "limits": {"type": "object"},

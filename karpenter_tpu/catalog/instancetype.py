@@ -212,6 +212,50 @@ def compute_requirements(info: InstanceTypeInfo, offerings: Sequence[Offering]) 
     return reqs
 
 
+def apply_kubelet(it: "InstanceType",
+                  kubelet: Optional[KubeletConfiguration]) -> "InstanceType":
+    """Re-derive the kubelet-dependent pieces of an existing type — pod
+    density, kube/system reserves, eviction thresholds — keeping every
+    non-kubelet knob (VM overhead shave, block device size, ENI density
+    mode) exactly as the catalog built it.  The per-NodePool analog of the
+    reference rebuilding its InstanceType list per kubelet hash
+    (/root/reference/pkg/providers/instancetype/instancetype.go:114-124,
+    types.go:53-72)."""
+    if kubelet is None or kubelet.key() is None:
+        return it
+    base_pods = int(it.capacity.get(PODS, DEFAULT_MAX_PODS))
+    cpu_m = it.info.cpu_m if it.info is not None else int(it.capacity.get(CPU, 0))
+    pod_count = kubelet.max_pods if kubelet.max_pods is not None else base_pods
+    if kubelet.pods_per_core:
+        pod_count = min(
+            kubelet.pods_per_core * max(cpu_m // 1000, 1), pod_count)
+    capacity = ResourceList(it.capacity)
+    capacity[PODS] = pod_count
+    return InstanceType(
+        name=it.name,
+        requirements=it.requirements,
+        offerings=it.offerings,
+        capacity=capacity,
+        kube_reserved=kube_reserved(cpu_m, pod_count, kubelet),
+        system_reserved=system_reserved(kubelet),
+        eviction_threshold=eviction_threshold(
+            int(it.capacity.get(MEMORY, 0)),
+            int(it.capacity.get(EPHEMERAL_STORAGE, 0)), kubelet),
+        info=it.info,
+    )
+
+
+def effective_instance_type(it: "InstanceType", pool) -> "InstanceType":
+    """The type as a node of `pool` actually presents it: kubelet-adjusted
+    when the pool carries a non-default KubeletConfiguration, untouched
+    otherwise (pool may be None — unknown/deleted pools register with the
+    catalog's own math).  The one helper every registration site shares so
+    the node's allocatable always matches what the solver packed against."""
+    if pool is None:
+        return it
+    return apply_kubelet(it, pool.template.kubelet)
+
+
 def new_instance_type(info: InstanceTypeInfo, offerings: Sequence[Offering],
                       kubelet: Optional[KubeletConfiguration] = None,
                       block_device_gib: int = 20,

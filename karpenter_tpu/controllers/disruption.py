@@ -37,7 +37,7 @@ from ..api import labels as wk
 from ..api.objects import Node, NodeClaim, NodePool, Pod, pool_view
 from ..api.resources import ResourceList
 from ..api.taints import NO_SCHEDULE, Taint
-from ..catalog.instancetype import InstanceType
+from ..catalog.instancetype import InstanceType, effective_instance_type
 from ..cloud.fake import CloudError
 from ..cloud.provider import CloudProvider, InsufficientCapacityError
 from ..ops.classpack import solve_classpack
@@ -548,6 +548,9 @@ class DisruptionController:
                     out.error = str(e)
                     return out
                 it = catalog_by_name.get(claim.instance_type)
+                if it is not None:
+                    it = effective_instance_type(
+                        it, self.nodepools.get(claim.nodepool))
                 node = self.cluster.register_nodeclaim(
                     claim, it.allocatable if it else claim.requests,
                     it.capacity if it else None)

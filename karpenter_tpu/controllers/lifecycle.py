@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..api import labels as wk
 from ..api.objects import Node, NodeClaim
+from ..catalog.instancetype import effective_instance_type
 from ..cloud.provider import CloudProvider
 from ..state.cluster import Cluster
 from ..utils import metrics
@@ -101,6 +102,9 @@ class LifecycleController:
     # ------------------------------------------------------------------
     def _register(self, claim: NodeClaim, out: LifecycleResult) -> None:
         it = self._catalog.get(claim.instance_type)
+        if it is not None:
+            it = effective_instance_type(
+                it, self.nodepools.get(claim.nodepool))
         allocatable = it.allocatable if it else claim.requests
         node = self.cluster.register_nodeclaim(
             claim, allocatable, it.capacity if it else None, initialized=False)

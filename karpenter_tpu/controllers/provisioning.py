@@ -25,6 +25,7 @@ from ..api import labels as wk
 from ..api.objects import Node, NodeClaim, NodePool, Pod, pool_view
 from ..api.requirements import IN, Requirement, Requirements
 from ..api.resources import PODS, ResourceList
+from ..catalog.instancetype import effective_instance_type
 from ..cloud.provider import (CloudProvider, InsufficientCapacityError,
                               NodeClassNotFoundError)
 from ..ops.constraints import (MAX_LEVEL, find_batch_topology_violations,
@@ -306,6 +307,9 @@ class Provisioner:
                 out.unschedulable.extend(dpods)
                 continue
             it = catalog_by_name.get(claim.instance_type)
+            if it is not None:
+                it = effective_instance_type(
+                    it, self.nodepools.get(claim.nodepool))
             allocatable = it.allocatable if it else claim.requests
             node = self.cluster.register_nodeclaim(claim, allocatable,
                                                    it.capacity if it else None)

@@ -17,6 +17,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..api.objects import NodeClass, NodePool
 from ..catalog.generate import generate_catalog
+from ..catalog.instancetype import effective_instance_type
 from ..cloud.batcher import BatchedCloud
 from ..cloud.cache import UnavailableOfferings
 from ..cloud.fake import CloudError, FakeCloud
@@ -142,6 +143,9 @@ class Operator:
             if self.cluster.claim_for_provider_id(claim.provider_id):
                 continue
             it = catalog_by_name.get(claim.instance_type)
+            if it is not None:
+                it = effective_instance_type(
+                    it, self.nodepools.get(claim.nodepool))
             allocatable = it.allocatable if it else claim.requests
             claim.created_at = claim.created_at or claim.launched_at
             node = self.cluster.register_nodeclaim(

@@ -311,13 +311,23 @@ class _CatalogSide:
         self.pool_taints = [p.template.taints for p in nodepools]
         group_ids: Dict[tuple, int] = {}
         self.groups: List[Requirements] = []
-        alloc_by_type: Dict[int, list] = {}
+        # per-(type, pool-kubelet) allocatable: a NodePool's kubelet config
+        # (maxPods, podsPerCore, reserved/eviction overrides) reshapes pod
+        # density and overhead for ITS options only — the reference rebuilds
+        # its InstanceType list per kubelet hash
+        # (/root/reference/pkg/providers/instancetype/instancetype.go:114-124)
+        from ..catalog.instancetype import apply_kubelet
+        kubelet_keys = [p.template.kubelet.key() for p in nodepools]
+        alloc_by_type: Dict[tuple, list] = {}
         for j, opt in enumerate(options):
             it = catalog[opt.type_index]
-            vec = alloc_by_type.get(opt.type_index)
+            kk = kubelet_keys[opt.pool_index]
+            vec = alloc_by_type.get((opt.type_index, kk))
             if vec is None:
-                vec = alloc_by_type[opt.type_index] = \
-                    it.allocatable.to_vector(axes, self.scales)
+                eff = it if kk is None else apply_kubelet(
+                    it, nodepools[opt.pool_index].template.kubelet)
+                vec = alloc_by_type[(opt.type_index, kk)] = \
+                    eff.allocatable.to_vector(axes, self.scales)
             self.option_alloc[j] = vec
             self.option_price[j] = opt.price
             self.option_zone[j] = zone_ids[opt.zone]
@@ -420,7 +430,8 @@ def _catside_fingerprint(catalog: Sequence[InstanceType],
         (p.name, p.weight,
          tuple(sorted(p.template.labels.items())),
          tuple(repr(t) for t in p.template.taints),
-         tuple(sorted((k, repr(r)) for k, r in p.template.requirements.items())))
+         tuple(sorted((k, repr(r)) for k, r in p.template.requirements.items())),
+         p.template.kubelet.key())
         for p in nodepools)
     scale_sig = (None if scales is None else
                  tuple(sorted((k, float(v)) for k, v in scales.items())))

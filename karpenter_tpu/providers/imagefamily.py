@@ -60,7 +60,7 @@ def _resolve_dns(kubelet: Optional[KubeletConfiguration],
     (v4 or v6 — IPv6 clusters bootstrap with their v6 service address).
     The ONE copy of the precedence rule for every userdata family."""
     if kubelet is not None and kubelet.cluster_dns:
-        return kubelet.cluster_dns
+        return kubelet.cluster_dns[0]
     return cluster_dns
 
 

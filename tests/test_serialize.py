@@ -301,3 +301,47 @@ class TestMachineConversion:
         errs = list(jsonschema.Draft202012Validator(
             schemas["Provisioner"]).iter_errors(bad))
         assert errs
+
+
+def test_nodepool_kubelet_round_trip():
+    """kubelet block survives manifest -> NodePool -> manifest (reference
+    NodePool CRD kubelet: maxPods/podsPerCore/kubeReserved/systemReserved/
+    evictionHard)."""
+    from karpenter_tpu.api.serialize import (nodepool_from_manifest,
+                                             nodepool_to_manifest)
+    m = {"apiVersion": "karpenter.sh/v1beta1", "kind": "NodePool",
+         "metadata": {"name": "dense"},
+         "spec": {"template": {"spec": {
+             "kubelet": {"maxPods": 30, "podsPerCore": 4,
+                         "kubeReserved": {"cpu": "500m", "memory": "1Gi"},
+                         "evictionHard": {"memory": "200Mi"}},
+             "nodeClassRef": {"name": "default"}}}}}
+    pool = nodepool_from_manifest(m)
+    kc = pool.template.kubelet
+    assert kc.max_pods == 30 and kc.pods_per_core == 4
+    assert kc.kube_reserved["cpu"] == 500
+    assert kc.kube_reserved["memory"] == 2**30
+    assert kc.eviction_hard["memory"] == 200 * 2**20
+    out = nodepool_to_manifest(pool)
+    kd = out["spec"]["template"]["spec"]["kubelet"]
+    assert kd["maxPods"] == 30 and kd["podsPerCore"] == 4
+    assert kd["kubeReserved"] == {"cpu": "500m", "memory": "1Gi"}
+    assert nodepool_from_manifest(out).template.kubelet == kc
+
+
+def test_kubelet_cluster_dns_list_round_trips():
+    from karpenter_tpu.api.serialize import (nodepool_from_manifest,
+                                             nodepool_to_manifest)
+    m = {"apiVersion": "karpenter.sh/v1beta1", "kind": "NodePool",
+         "metadata": {"name": "dns"},
+         "spec": {"template": {"spec": {
+             "kubelet": {"clusterDNS": ["10.0.0.10", "10.0.0.11"]},
+             "nodeClassRef": {"name": "default"}}}}}
+    pool = nodepool_from_manifest(m)
+    assert pool.template.kubelet.cluster_dns == ("10.0.0.10", "10.0.0.11")
+    out = nodepool_to_manifest(pool)
+    assert out["spec"]["template"]["spec"]["kubelet"]["clusterDNS"] == \
+        ["10.0.0.10", "10.0.0.11"]
+    # unknown upstream kubelet fields are tolerated, not rejected
+    m["spec"]["template"]["spec"]["kubelet"]["cpuCFSQuota"] = True
+    nodepool_from_manifest(m)

@@ -336,3 +336,85 @@ class TestExtendedResourceAxes:
         r = solve_classpack(prob)
         assert not r.unschedulable
         assert len(r.nodes) == 1  # all 100 fit one node, not 64-per-node
+
+
+class TestKubeletConfiguration:
+    """Per-NodePool kubelet config reshapes pod density and overhead for
+    that pool's options (reference rebuilds its InstanceType list per
+    kubelet hash, pkg/providers/instancetype/instancetype.go:114-124,
+    types.go:333-416)."""
+
+    def test_max_pods_caps_density(self):
+        from karpenter_tpu.api.objects import KubeletConfiguration
+        from karpenter_tpu.ops.classpack import solve_classpack
+        pool = NodePool(template=NodePoolTemplate(
+            kubelet=KubeletConfiguration(max_pods=4)))
+        pods = [cpu_pod(cpu_m=50, mem_mib=64) for _ in range(10)]
+        prob = tensorize(pods, small_catalog(), [pool])
+        r = solve_classpack(prob)
+        assert not r.unschedulable
+        assert len(r.nodes) == 3                    # ceil(10/4), not 1
+        assert max(len(n.pod_indices) for n in r.nodes) <= 4
+
+    def test_pods_per_core_caps_density(self):
+        from karpenter_tpu.api.objects import KubeletConfiguration
+        from karpenter_tpu.ops.tensorize import tensorize as tz
+        from karpenter_tpu.api.resources import PODS
+        pool = NodePool(template=NodePoolTemplate(
+            kubelet=KubeletConfiguration(pods_per_core=2)))
+        prob = tz([cpu_pod()], small_catalog(), [pool])
+        ax = prob.axes.index(PODS)
+        # a.small has 2 cores -> 4 pod slots under pods_per_core=2
+        small_cols = [j for j, o in enumerate(prob.options)
+                      if o.instance_type == "a.small"]
+        assert all(prob.option_alloc[j, ax] == 4 for j in small_cols)
+
+    def test_kube_reserved_override_shrinks_allocatable(self):
+        from karpenter_tpu.api.objects import KubeletConfiguration
+        from karpenter_tpu.api.resources import CPU as CPU_R, ResourceList as RL
+        from karpenter_tpu.ops.tensorize import tensorize as tz
+        base = tz([cpu_pod()], small_catalog(), [NodePool()])
+        pool = NodePool(template=NodePoolTemplate(
+            kubelet=KubeletConfiguration(
+                kube_reserved=RL({CPU_R: 1000}))))
+        cfg = tz([cpu_pod()], small_catalog(), [pool])
+        ax = cfg.axes.index(CPU_R)
+        # reserved CPU grew to a full core -> every column loses capacity
+        assert (cfg.option_alloc[:, ax] < base.option_alloc[:, ax]).all()
+
+    def test_two_pools_same_type_different_density(self):
+        from karpenter_tpu.api.objects import KubeletConfiguration
+        from karpenter_tpu.api.resources import PODS
+        dense = NodePool(name="dense", template=NodePoolTemplate(
+            labels={"p": "dense"}))
+        sparse_p = NodePool(name="sparse", template=NodePoolTemplate(
+            labels={"p": "sparse"},
+            kubelet=KubeletConfiguration(max_pods=2)))
+        prob = tensorize([cpu_pod()], small_catalog(), [dense, sparse_p])
+        ax = prob.axes.index(PODS)
+        by_pool = {}
+        for j, o in enumerate(prob.options):
+            if o.instance_type == "a.small":
+                by_pool[o.pool] = prob.option_alloc[j, ax]
+        assert by_pool["sparse"] == 2
+        assert by_pool["dense"] > 2
+
+    def test_registered_node_carries_kubelet_allocatable(self):
+        from karpenter_tpu.api.objects import KubeletConfiguration
+        from karpenter_tpu.api.resources import PODS
+        from karpenter_tpu.cloud import CloudProvider, FakeCloud
+        from karpenter_tpu.controllers import Provisioner
+        from karpenter_tpu.state import Cluster
+        pool = NodePool(template=NodePoolTemplate(
+            kubelet=KubeletConfiguration(max_pods=3)))
+        provider = CloudProvider(FakeCloud(), small_catalog())
+        cluster = Cluster()
+        prov = Provisioner(provider, cluster, [pool])
+        cluster.add_pods([cpu_pod(cpu_m=50) for _ in range(3)])
+        res = prov.provision()
+        assert res.scheduled == 3
+        node = next(iter(cluster.nodes.values()))
+        assert node.allocatable[PODS] == 3
+        # a 4th pod cannot bind to the full node: new capacity launches
+        res2 = prov.provision([cpu_pod(cpu_m=50)])
+        assert res2.bound_existing == 0 and len(res2.launched) == 1
