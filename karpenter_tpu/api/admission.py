@@ -221,6 +221,34 @@ def validate_nodeclass(nodeclass) -> None:
         errs.append("block device must be >= 1 GiB")
     if nodeclass.block_device_gib > 64 * 1024:
         errs.append("block device must be <= 64 TiB")
+    for i, bdm in enumerate(nodeclass.block_device_mappings):
+        if not bdm.get("deviceName"):
+            errs.append(f"blockDeviceMappings[{i}].deviceName required")
+        ebs = bdm.get("ebs", {})
+        vt = ebs.get("volumeType")
+        if vt is not None and vt not in ("gp2", "gp3", "io1", "io2", "st1",
+                                         "sc1", "standard"):
+            errs.append(f"blockDeviceMappings[{i}].ebs.volumeType "
+                        f"{vt!r} unknown")
+        if vt in ("io1", "io2") and not ebs.get("iops"):
+            errs.append(f"blockDeviceMappings[{i}].ebs.iops required "
+                        f"for {vt}")
+    mo = nodeclass.metadata_options
+    if mo.get("httpTokens") not in (None, "required", "optional"):
+        errs.append("metadataOptions.httpTokens must be required|optional")
+    if mo.get("httpEndpoint") not in (None, "enabled", "disabled"):
+        errs.append("metadataOptions.httpEndpoint must be enabled|disabled")
+    hop = mo.get("httpPutResponseHopLimit")
+    if hop is not None:
+        try:
+            ok_hop = 1 <= int(hop) <= 64
+        except (TypeError, ValueError):
+            ok_hop = False
+        if not ok_hop:
+            errs.append("metadataOptions.httpPutResponseHopLimit must be "
+                        "an integer in 1-64")
+    if nodeclass.instance_store_policy not in ("", "RAID0"):
+        errs.append("instanceStorePolicy must be RAID0 when set")
     _validate_selector(nodeclass.subnet_selector, errs, "subnetSelectorTerms",
                        allow_name=True)
     _validate_selector(nodeclass.security_group_selector, errs,

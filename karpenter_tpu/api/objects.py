@@ -212,6 +212,17 @@ class NodeClass:
     user_data: str = ""
     tags: Dict[str, str] = field(default_factory=dict)
     block_device_gib: int = 20
+    # full block-device surface (reference spec.blockDeviceMappings,
+    # ec2nodeclass.go:30-113): list of {deviceName, ebs:{volumeSize,
+    # volumeType, iops, throughput, encrypted, deleteOnTermination, ...}}.
+    # Empty == the single root volume implied by block_device_gib.
+    block_device_mappings: List[Dict] = field(default_factory=list)
+    # IMDS exposure (reference spec.metadataOptions): httpEndpoint,
+    # httpTokens, httpPutResponseHopLimit, httpProtocolIPv6
+    metadata_options: Dict[str, object] = field(default_factory=dict)
+    detailed_monitoring: bool = False
+    instance_store_policy: str = ""      # "" | "RAID0"
+    associate_public_ip: Optional[bool] = None
     # resolved status (set by the nodeclass controller)
     status_zones: List[str] = field(default_factory=list)
     status_subnets: List[str] = field(default_factory=list)

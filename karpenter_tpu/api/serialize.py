@@ -194,6 +194,16 @@ def nodeclass_to_manifest(nc: NodeClass) -> Dict:
         "tags": dict(nc.tags),
         "blockDeviceGiB": nc.block_device_gib,
     }
+    if nc.block_device_mappings:
+        spec["blockDeviceMappings"] = [dict(m) for m in nc.block_device_mappings]
+    if nc.metadata_options:
+        spec["metadataOptions"] = dict(nc.metadata_options)
+    if nc.detailed_monitoring:
+        spec["detailedMonitoring"] = True
+    if nc.instance_store_policy:
+        spec["instanceStorePolicy"] = nc.instance_store_policy
+    if nc.associate_public_ip is not None:
+        spec["associatePublicIPAddress"] = nc.associate_public_ip
     if nc.zone_selector:
         spec["zones"] = list(nc.zone_selector)
     out = {"apiVersion": f"{GROUP}/{VERSION}", "kind": "NodeClass",
@@ -239,6 +249,12 @@ def nodeclass_from_manifest(m: Dict, validate: bool = True) -> NodeClass:
         user_data=spec.get("userData", ""),
         tags=dict(spec.get("tags", {})),
         block_device_gib=int(spec.get("blockDeviceGiB", 20)),
+        block_device_mappings=[dict(x)
+                               for x in spec.get("blockDeviceMappings", [])],
+        metadata_options=dict(spec.get("metadataOptions", {})),
+        detailed_monitoring=bool(spec.get("detailedMonitoring", False)),
+        instance_store_policy=spec.get("instanceStorePolicy", ""),
+        associate_public_ip=spec.get("associatePublicIPAddress"),
     )
     if validate:
         from .admission import default_nodeclass, validate_nodeclass
@@ -548,6 +564,50 @@ def crd_schemas() -> Dict[str, Dict]:
                         "role": {"type": "string"},
                         "userData": {"type": "string"},
                         "blockDeviceGiB": {"type": "integer", "minimum": 1},
+                        "blockDeviceMappings": {
+                            "type": "array",
+                            "items": {
+                                "type": "object",
+                                "properties": {
+                                    "deviceName": {"type": "string"},
+                                    "ebs": {
+                                        "type": "object",
+                                        "properties": {
+                                            "volumeSize": {"oneOf": [
+                                                {"type": "string"},
+                                                {"type": "number"}]},
+                                            "volumeType": {
+                                                "enum": ["gp2", "gp3", "io1",
+                                                         "io2", "st1", "sc1",
+                                                         "standard"]},
+                                            "iops": {"type": "integer"},
+                                            "throughput": {"type": "integer"},
+                                            "encrypted": {"type": "boolean"},
+                                            "deleteOnTermination": {
+                                                "type": "boolean"},
+                                            "snapshotID": {"type": "string"},
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                        "metadataOptions": {
+                            "type": "object",
+                            "properties": {
+                                "httpEndpoint": {
+                                    "enum": ["enabled", "disabled"]},
+                                "httpTokens": {
+                                    "enum": ["required", "optional"]},
+                                "httpPutResponseHopLimit": {
+                                    "type": "integer", "minimum": 1,
+                                    "maximum": 64},
+                                "httpProtocolIPv6": {
+                                    "enum": ["enabled", "disabled"]},
+                            },
+                        },
+                        "detailedMonitoring": {"type": "boolean"},
+                        "instanceStorePolicy": {"enum": ["RAID0"]},
+                        "associatePublicIPAddress": {"type": "boolean"},
                     },
                 },
             },

@@ -245,12 +245,48 @@ def apply_kubelet(it: "InstanceType",
     )
 
 
-def effective_instance_type(it: "InstanceType", pool) -> "InstanceType":
-    """The type as a node of `pool` actually presents it: kubelet-adjusted
-    when the pool carries a non-default KubeletConfiguration, untouched
-    otherwise (pool may be None — unknown/deleted pools register with the
-    catalog's own math).  The one helper every registration site shares so
-    the node's allocatable always matches what the solver packed against."""
+def root_volume_gib(nodeclass) -> Optional[int]:
+    """The boot volume size a node of this nodeclass actually gets: the
+    root mapping's ebs.volumeSize when blockDeviceMappings are set
+    (reference derives ephemeral-storage from the mapped root volume),
+    else block_device_gib; None for no nodeclass."""
+    if nodeclass is None:
+        return None
+    for m in nodeclass.block_device_mappings:
+        size = (m.get("ebs") or {}).get("volumeSize")
+        if size is not None:
+            from ..api.resources import EPHEMERAL_STORAGE, parse_quantity
+            return max(1, int(parse_quantity(size, EPHEMERAL_STORAGE) // GiB))
+    return int(nodeclass.block_device_gib)
+
+
+def apply_storage(it: "InstanceType", root_gib: Optional[int]) -> "InstanceType":
+    """Re-derive ephemeral-storage capacity (and its 10% hard-eviction
+    share) for a different boot volume size, keeping everything else."""
+    if root_gib is None or int(it.capacity.get(EPHEMERAL_STORAGE, 0)) == \
+            root_gib * GiB:
+        return it
+    storage = root_gib * GiB
+    capacity = ResourceList(it.capacity)
+    capacity[EPHEMERAL_STORAGE] = storage
+    eviction = ResourceList(it.eviction_threshold)
+    eviction[EPHEMERAL_STORAGE] = int(math.ceil(storage / 10))
+    return InstanceType(
+        name=it.name, requirements=it.requirements, offerings=it.offerings,
+        capacity=capacity, kube_reserved=it.kube_reserved,
+        system_reserved=it.system_reserved, eviction_threshold=eviction,
+        info=it.info)
+
+
+def effective_instance_type(it: "InstanceType", pool,
+                            nodeclass=None) -> "InstanceType":
+    """The type as a node of `pool` actually presents it: boot-volume
+    storage from the pool's nodeclass, then kubelet-adjusted density and
+    reserves (either may be None/default — unknown pools register with the
+    catalog's own math).  The one helper every registration site AND the
+    solver's per-pool catalog columns share, so node allocatable always
+    matches what the solver packed against."""
+    it = apply_storage(it, root_volume_gib(nodeclass))
     if pool is None:
         return it
     return apply_kubelet(it, pool.template.kubelet)

@@ -107,6 +107,11 @@ class LaunchTemplateInfo:
     user_data: str = ""
     security_group_ids: Tuple[str, ...] = ()
     block_device_gib: int = 20
+    block_device_mappings: Tuple[str, ...] = ()   # canonical JSON strings
+    metadata_options: Tuple = ()                  # sorted (key, value) pairs
+    detailed_monitoring: bool = False
+    instance_store_policy: str = ""
+    associate_public_ip: object = None            # None == subnet default
     instance_profile: str = ""
     tags: Dict[str, str] = field(default_factory=dict)
 

@@ -229,11 +229,20 @@ class CloudProvider:
         /root/reference/pkg/providers/instance/instance.go:88-105)."""
         if not claim.created_at:
             claim.created_at = self.clock()
-        candidates = _claim_compatible_types(claim, self.instance_types.list())
+        nodeclass = self.node_classes.get(claim.node_class_ref)
+        # capacity-fit validation must see the nodeclass's boot volume: a
+        # mapped 200Gi root makes storage-heavy claims valid even though
+        # the base catalog's default volume couldn't hold them (the solver
+        # already packed against the adjusted columns)
+        types = self.instance_types.list()
+        if nodeclass is not None:
+            from ..catalog.instancetype import apply_storage, root_volume_gib
+            gib = root_volume_gib(nodeclass)
+            types = [apply_storage(it, gib) for it in types]
+        candidates = _claim_compatible_types(claim, types)
         if not candidates:
             raise InsufficientCapacityError(
                 f"no compatible instance types for claim {claim.name}")
-        nodeclass = self.node_classes.get(claim.node_class_ref)
         if nodeclass is None and (self.subnets is not None
                                   or self.launch_templates is not None):
             # with the L2 path wired, a dangling nodeclass ref is a config

@@ -248,7 +248,9 @@ class DisruptionController:
                           zone_feasible=make_zone_feasibility(
                               catalog, self.cluster.nodes.values(),
                               exclude_nodes=exclude_names))
-        problem = tensorize(pods, catalog, pools)
+        problem = tensorize(pods, catalog, pools,
+                            node_classes=getattr(self.provider,
+                                                 "node_classes", None))
         node_list, alloc, used, compat = self.cluster.tensorize_nodes(
             problem.class_reps, problem.axes, exclude=exclude_names,
             scales=problem.scales)
@@ -549,8 +551,10 @@ class DisruptionController:
                     return out
                 it = catalog_by_name.get(claim.instance_type)
                 if it is not None:
+                    ncs = getattr(self.provider, "node_classes", None) or {}
                     it = effective_instance_type(
-                        it, self.nodepools.get(claim.nodepool))
+                        it, self.nodepools.get(claim.nodepool),
+                        ncs.get(claim.node_class_ref))
                 node = self.cluster.register_nodeclaim(
                     claim, it.allocatable if it else claim.requests,
                     it.capacity if it else None)

@@ -103,8 +103,10 @@ class LifecycleController:
     def _register(self, claim: NodeClaim, out: LifecycleResult) -> None:
         it = self._catalog.get(claim.instance_type)
         if it is not None:
+            ncs = getattr(self.provider, "node_classes", None) or {}
             it = effective_instance_type(
-                it, self.nodepools.get(claim.nodepool))
+                it, self.nodepools.get(claim.nodepool),
+                ncs.get(claim.node_class_ref))
         allocatable = it.allocatable if it else claim.requests
         node = self.cluster.register_nodeclaim(
             claim, allocatable, it.capacity if it else None, initialized=False)

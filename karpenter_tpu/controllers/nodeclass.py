@@ -40,6 +40,11 @@ def static_hash(nodeclass: NodeClass) -> str:
         "user_data": nodeclass.user_data,
         "tags": sorted(nodeclass.tags.items()),
         "block_device_gib": nodeclass.block_device_gib,
+        "block_device_mappings": nodeclass.block_device_mappings,
+        "metadata_options": sorted(nodeclass.metadata_options.items()),
+        "detailed_monitoring": nodeclass.detailed_monitoring,
+        "instance_store_policy": nodeclass.instance_store_policy,
+        "associate_public_ip": nodeclass.associate_public_ip,
     }, sort_keys=True)
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 

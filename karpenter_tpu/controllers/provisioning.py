@@ -182,7 +182,9 @@ class Provisioner:
             lowered = lower_pods(pods, nodes=self.cluster.nodes.values(),
                                  option_zones=zones, zone_rank=zone_rank,
                                  level=level, zone_feasible=zone_feasible)
-            problem = tensorize(lowered, catalog, pools)
+            problem = tensorize(lowered, catalog, pools,
+                                node_classes=getattr(self.provider,
+                                                     "node_classes", None))
             if schedule_on_existing and self.cluster.nodes:
                 node_list, alloc, used, compat = self.cluster.tensorize_nodes(
                     problem.class_reps, problem.axes, scales=problem.scales)
@@ -308,8 +310,10 @@ class Provisioner:
                 continue
             it = catalog_by_name.get(claim.instance_type)
             if it is not None:
+                ncs = getattr(self.provider, "node_classes", None) or {}
                 it = effective_instance_type(
-                    it, self.nodepools.get(claim.nodepool))
+                    it, self.nodepools.get(claim.nodepool),
+                    ncs.get(claim.node_class_ref))
             allocatable = it.allocatable if it else claim.requests
             node = self.cluster.register_nodeclaim(claim, allocatable,
                                                    it.capacity if it else None)

@@ -145,7 +145,8 @@ class Operator:
             it = catalog_by_name.get(claim.instance_type)
             if it is not None:
                 it = effective_instance_type(
-                    it, self.nodepools.get(claim.nodepool))
+                    it, self.nodepools.get(claim.nodepool),
+                    self.node_classes.get(claim.node_class_ref))
             allocatable = it.allocatable if it else claim.requests
             claim.created_at = claim.created_at or claim.launched_at
             node = self.cluster.register_nodeclaim(
@@ -201,6 +202,10 @@ class Operator:
                     claim.provider_id):
                 it = next((t for t in self.catalog
                            if t.name == claim.instance_type), None)
+                if it is not None:
+                    it = effective_instance_type(
+                        it, self.nodepools.get(claim.nodepool),
+                        self.node_classes.get(claim.node_class_ref))
                 allocatable = it.allocatable if it else claim.requests
                 claim.created_at = claim.created_at or claim.launched_at
                 node = self.cluster.register_nodeclaim(

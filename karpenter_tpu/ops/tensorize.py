@@ -288,12 +288,14 @@ class _CatalogSide:
 
     def __init__(self, catalog: Sequence[InstanceType],
                  nodepools: Sequence[NodePool], axes: Tuple[str, ...],
-                 scales: Optional[Mapping[str, float]] = None):
+                 scales: Optional[Mapping[str, float]] = None,
+                 node_classes: Optional[Mapping[str, object]] = None):
         # strong refs keep the fingerprint's id()s stable for the cache's life
         self.catalog = list(catalog)
         self.nodepools = list(nodepools)
         self.axes = axes
         self.scales = DEFAULT_SCALES if scales is None else scales
+        node_classes = node_classes or {}
         options = build_options(catalog, nodepools)
         self.options = options
         O, R = len(options), len(axes)
@@ -316,17 +318,24 @@ class _CatalogSide:
         # density and overhead for ITS options only — the reference rebuilds
         # its InstanceType list per kubelet hash
         # (/root/reference/pkg/providers/instancetype/instancetype.go:114-124)
-        from ..catalog.instancetype import apply_kubelet
+        from ..catalog.instancetype import (apply_kubelet, apply_storage,
+                                            root_volume_gib)
         kubelet_keys = [p.template.kubelet.key() for p in nodepools]
+        ncs = node_classes or {}
+        storage_gib = [root_volume_gib(ncs.get(p.template.node_class_ref))
+                       for p in nodepools]
         alloc_by_type: Dict[tuple, list] = {}
         for j, opt in enumerate(options):
             it = catalog[opt.type_index]
             kk = kubelet_keys[opt.pool_index]
-            vec = alloc_by_type.get((opt.type_index, kk))
+            sg = storage_gib[opt.pool_index]
+            vec = alloc_by_type.get((opt.type_index, kk, sg))
             if vec is None:
-                eff = it if kk is None else apply_kubelet(
-                    it, nodepools[opt.pool_index].template.kubelet)
-                vec = alloc_by_type[(opt.type_index, kk)] = \
+                eff = apply_storage(it, sg)
+                if kk is not None:
+                    eff = apply_kubelet(
+                        eff, nodepools[opt.pool_index].template.kubelet)
+                vec = alloc_by_type[(opt.type_index, kk, sg)] = \
                     eff.allocatable.to_vector(axes, self.scales)
             self.option_alloc[j] = vec
             self.option_price[j] = opt.price
@@ -413,7 +422,8 @@ _CATSIDE_MAX = 8
 def _catside_fingerprint(catalog: Sequence[InstanceType],
                          nodepools: Sequence[NodePool],
                          axes: Tuple[str, ...],
-                         scales: Optional[Mapping[str, float]] = None) -> tuple:
+                         scales: Optional[Mapping[str, float]] = None,
+                         node_classes: Optional[Mapping[str, object]] = None) -> tuple:
     # requirements are keyed by an int hash over EVERY Requirement field
     # (not Requirement.__hash__, which omits min_values) — full content
     # tuples would triple the cost of this hot-path fingerprint, and a
@@ -435,19 +445,25 @@ def _catside_fingerprint(catalog: Sequence[InstanceType],
         for p in nodepools)
     scale_sig = (None if scales is None else
                  tuple(sorted((k, float(v)) for k, v in scales.items())))
-    return (cat_sig, pool_sig, axes, scale_sig)
+    # only the nodeclass content the columns consume: per-pool root volume
+    from ..catalog.instancetype import root_volume_gib
+    ncs = node_classes or {}
+    storage_sig = tuple(root_volume_gib(ncs.get(p.template.node_class_ref))
+                        for p in nodepools)
+    return (cat_sig, pool_sig, axes, scale_sig, storage_sig)
 
 
 def catalog_side(catalog: Sequence[InstanceType],
                  nodepools: Sequence[NodePool],
                  axes: Tuple[str, ...] = DEFAULT_AXES,
-                 scales: Optional[Mapping[str, float]] = None) -> _CatalogSide:
-    key = _catside_fingerprint(catalog, nodepools, axes, scales)
+                 scales: Optional[Mapping[str, float]] = None,
+                 node_classes: Optional[Mapping[str, object]] = None) -> _CatalogSide:
+    key = _catside_fingerprint(catalog, nodepools, axes, scales, node_classes)
     side = _CATSIDE_CACHE.get(key)
     if side is None:
         if len(_CATSIDE_CACHE) >= _CATSIDE_MAX:
             _CATSIDE_CACHE.pop(next(iter(_CATSIDE_CACHE)), None)
-        side = _CatalogSide(catalog, nodepools, axes, scales)
+        side = _CatalogSide(catalog, nodepools, axes, scales, node_classes)
     else:
         _CATSIDE_CACHE.pop(key)  # re-insert: eviction order becomes LRU
     _CATSIDE_CACHE[key] = side
@@ -456,7 +472,8 @@ def catalog_side(catalog: Sequence[InstanceType],
 
 def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
               nodepools: Sequence[NodePool],
-              axes: Tuple[str, ...] = DEFAULT_AXES) -> Problem:
+              axes: Tuple[str, ...] = DEFAULT_AXES,
+              node_classes: Optional[Mapping[str, object]] = None) -> Problem:
     """Lower a scheduling round to dense arrays."""
     # pod equivalence classes, grouped in numpy over interned class ids —
     # one attribute read per pod instead of a dict-build round trip; class
@@ -514,7 +531,7 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
             if big >= 2.0**30:
                 scales[k] = 2.0 ** math.ceil(math.log2(big) - 30)
 
-    side = catalog_side(catalog, nodepools, axes, scales)
+    side = catalog_side(catalog, nodepools, axes, scales, node_classes)
     O, R = len(side.options), len(axes)
 
     C = len(reps)

@@ -19,6 +19,7 @@ Re-implements /root/reference/pkg/providers/amifamily/:
 from __future__ import annotations
 
 import email
+import json
 from dataclasses import dataclass, field
 from email.mime.multipart import MIMEMultipart
 from email.mime.text import MIMEText
@@ -47,6 +48,11 @@ class LaunchSpec:
     security_group_ids: Tuple[str, ...] = ()
     instance_profile: str = ""
     block_device_gib: int = 20
+    block_device_mappings: tuple = ()
+    metadata_options: tuple = ()         # sorted (key, value) pairs
+    detailed_monitoring: bool = False
+    instance_store_policy: str = ""
+    associate_public_ip: Optional[bool] = None
     tags: Dict[str, str] = field(default_factory=dict)
 
 
@@ -259,5 +265,13 @@ class Resolver:
                 instance_types=its, security_group_ids=security_group_ids,
                 instance_profile=instance_profile,
                 block_device_gib=nodeclass.block_device_gib,
+                block_device_mappings=tuple(
+                    json.dumps(m, sort_keys=True)
+                    for m in nodeclass.block_device_mappings),
+                metadata_options=tuple(
+                    sorted(nodeclass.metadata_options.items())),
+                detailed_monitoring=nodeclass.detailed_monitoring,
+                instance_store_policy=nodeclass.instance_store_policy,
+                associate_public_ip=nodeclass.associate_public_ip,
                 tags=dict(nodeclass.tags)))
         return specs

@@ -50,6 +50,11 @@ def template_name(spec: LaunchSpec, cluster_name: str,
         "sgs": sorted(spec.security_group_ids),
         "profile": spec.instance_profile,
         "bdm": spec.block_device_gib,
+        "bdms": list(spec.block_device_mappings),
+        "imds": list(spec.metadata_options),
+        "monitoring": spec.detailed_monitoring,
+        "store_policy": spec.instance_store_policy,
+        "public_ip": spec.associate_public_ip,
         "tags": sorted(spec.tags.items()),
         "cluster": cluster_name,
         "nodeclass": nodeclass_name,
@@ -88,6 +93,11 @@ class LaunchTemplateProvider:
             name=name, image_id=spec.image.id, user_data=spec.user_data,
             security_group_ids=tuple(spec.security_group_ids),
             block_device_gib=spec.block_device_gib,
+            block_device_mappings=tuple(spec.block_device_mappings),
+            metadata_options=tuple(spec.metadata_options),
+            detailed_monitoring=spec.detailed_monitoring,
+            instance_store_policy=spec.instance_store_policy,
+            associate_public_ip=spec.associate_public_ip,
             instance_profile=spec.instance_profile,
             tags={**spec.tags, "karpenter.sh/cluster": self.cluster_name,
                   "karpenter.sh/nodeclass": nodeclass.name})

@@ -345,3 +345,82 @@ def test_kubelet_cluster_dns_list_round_trips():
     # unknown upstream kubelet fields are tolerated, not rejected
     m["spec"]["template"]["spec"]["kubelet"]["cpuCFSQuota"] = True
     nodepool_from_manifest(m)
+
+
+class TestNodeClassLaunchSurface:
+    """blockDeviceMappings / metadataOptions / detailedMonitoring /
+    instanceStorePolicy / associatePublicIPAddress round-trip, hash into
+    drift, and shape launch-template identity (reference
+    ec2nodeclass.go:30-113 spec surface)."""
+
+    MANIFEST = {
+        "apiVersion": "karpenter.sh/v1beta1", "kind": "NodeClass",
+        "metadata": {"name": "full"},
+        "spec": {
+            "imageFamily": "standard",
+            "blockDeviceMappings": [
+                {"deviceName": "/dev/xvda",
+                 "ebs": {"volumeSize": "100Gi", "volumeType": "gp3",
+                         "encrypted": True, "deleteOnTermination": True}}],
+            "metadataOptions": {"httpTokens": "required",
+                                "httpPutResponseHopLimit": 2},
+            "detailedMonitoring": True,
+            "instanceStorePolicy": "RAID0",
+            "associatePublicIPAddress": False,
+        },
+    }
+
+    def test_round_trip(self):
+        from karpenter_tpu.api.serialize import (nodeclass_from_manifest,
+                                                 nodeclass_to_manifest)
+        nc = nodeclass_from_manifest(self.MANIFEST)
+        assert nc.block_device_mappings[0]["ebs"]["volumeType"] == "gp3"
+        assert nc.metadata_options["httpTokens"] == "required"
+        assert nc.detailed_monitoring and nc.instance_store_policy == "RAID0"
+        assert nc.associate_public_ip is False
+        out = nodeclass_to_manifest(nc)
+        assert out["spec"]["blockDeviceMappings"] == \
+            self.MANIFEST["spec"]["blockDeviceMappings"]
+        assert out["spec"]["metadataOptions"]["httpPutResponseHopLimit"] == 2
+        nc2 = nodeclass_from_manifest(out)
+        assert nc2.block_device_mappings == nc.block_device_mappings
+
+    def test_admission_rejections(self):
+        import copy
+        import pytest
+        from karpenter_tpu.api.admission import ValidationError
+        from karpenter_tpu.api.serialize import nodeclass_from_manifest
+        bad = copy.deepcopy(self.MANIFEST)
+        bad["spec"]["metadataOptions"]["httpTokens"] = "sometimes"
+        with pytest.raises(ValidationError):
+            nodeclass_from_manifest(bad)
+        bad = copy.deepcopy(self.MANIFEST)
+        bad["spec"]["blockDeviceMappings"] = [
+            {"ebs": {"volumeType": "gp3"}}]           # missing deviceName
+        with pytest.raises(ValidationError):
+            nodeclass_from_manifest(bad)
+        bad = copy.deepcopy(self.MANIFEST)
+        bad["spec"]["blockDeviceMappings"] = [
+            {"deviceName": "/dev/xvda", "ebs": {"volumeType": "io2"}}]
+        with pytest.raises(ValidationError):           # io2 without iops
+            nodeclass_from_manifest(bad)
+
+    def test_changes_drift_hash_and_template_identity(self):
+        from karpenter_tpu.api.serialize import nodeclass_from_manifest
+        from karpenter_tpu.controllers.nodeclass import static_hash
+        from karpenter_tpu.providers.imagefamily import LaunchSpec, ImageInfo
+        from karpenter_tpu.providers.launchtemplate import template_name
+        nc = nodeclass_from_manifest(self.MANIFEST)
+        h1 = static_hash(nc)
+        nc.metadata_options = dict(nc.metadata_options,
+                                   httpPutResponseHopLimit=4)
+        assert static_hash(nc) != h1
+        img = ImageInfo("img-1", "std", "amd64", 1.0)
+        a = LaunchSpec(image=img, user_data="", instance_types=[],
+                       metadata_options=(("httpTokens", "required"),))
+        b = LaunchSpec(image=img, user_data="", instance_types=[],
+                       metadata_options=(("httpTokens", "optional"),))
+        assert template_name(a, "c") != template_name(b, "c")
+        c = LaunchSpec(image=img, user_data="", instance_types=[],
+                       block_device_mappings=('{"deviceName": "/dev/xvda"}',))
+        assert template_name(c, "c") != template_name(a, "c")

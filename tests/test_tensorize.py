@@ -418,3 +418,56 @@ class TestKubeletConfiguration:
         # a 4th pod cannot bind to the full node: new capacity launches
         res2 = prov.provision([cpu_pod(cpu_m=50)])
         assert res2.bound_existing == 0 and len(res2.launched) == 1
+
+
+class TestNodeClassStorageCapacity:
+    """The mapped root volume (blockDeviceMappings ebs.volumeSize, else
+    blockDeviceGiB) drives ephemeral-storage capacity in the solver's
+    per-pool columns AND the registered node — the reference derives
+    ephemeral storage from the mapped root volume."""
+
+    def test_solver_sees_mapped_root_volume(self):
+        from karpenter_tpu.api.objects import NodeClass
+        from karpenter_tpu.api.resources import EPHEMERAL_STORAGE
+        nc = NodeClass(name="big", block_device_mappings=[
+            {"deviceName": "/dev/xvda", "ebs": {"volumeSize": "100Gi"}}])
+        pool = NodePool(template=NodePoolTemplate(node_class_ref="big"))
+        prob = tensorize([cpu_pod()], small_catalog(), [pool],
+                         node_classes={"big": nc})
+        ax = prob.axes.index(EPHEMERAL_STORAGE)
+        # capacity 100Gi minus 10% eviction minus 1Gi kube-reserved, in MiB
+        assert prob.option_alloc[:, ax].max() > 80 * 1024
+        base = tensorize([cpu_pod()], small_catalog(), [NodePool()])
+        assert prob.option_alloc[:, ax].max() > base.option_alloc[:, ax].max()
+
+    def test_storage_pod_schedules_only_with_big_volume(self):
+        from karpenter_tpu.api.objects import NodeClass
+        from karpenter_tpu.api.resources import (CPU, EPHEMERAL_STORAGE,
+                                                 ResourceList)
+        from karpenter_tpu.ops.classpack import solve_classpack
+        pod = Pod(requests=ResourceList(
+            {CPU: 100, EPHEMERAL_STORAGE: 50 * 2**30}))
+        # default 20GiB boot volume: unschedulable
+        prob = tensorize([pod], small_catalog(), [NodePool()])
+        assert len(solve_classpack(prob).unschedulable) == 1
+        # 100GiB mapped volume: schedules
+        nc = NodeClass(name="big", block_device_mappings=[
+            {"deviceName": "/dev/xvda", "ebs": {"volumeSize": "100Gi"}}])
+        pool = NodePool(template=NodePoolTemplate(node_class_ref="big"))
+        prob2 = tensorize([pod], small_catalog(), [pool],
+                          node_classes={"big": nc})
+        r = solve_classpack(prob2)
+        assert not r.unschedulable
+
+    def test_registered_node_carries_storage(self):
+        from karpenter_tpu.api.objects import NodeClass
+        from karpenter_tpu.api.resources import EPHEMERAL_STORAGE
+        from karpenter_tpu.catalog.instancetype import effective_instance_type
+        nc = NodeClass(name="big", block_device_mappings=[
+            {"deviceName": "/dev/xvda", "ebs": {"volumeSize": "100Gi"}}])
+        it = small_catalog()[0]
+        eff = effective_instance_type(it, NodePool(), nc)
+        assert eff.capacity[EPHEMERAL_STORAGE] == 100 * 2**30
+        assert eff.allocatable[EPHEMERAL_STORAGE] < 100 * 2**30
+        # no nodeclass: untouched
+        assert effective_instance_type(it, NodePool(), None) is it
