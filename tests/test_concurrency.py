@@ -163,3 +163,50 @@ class TestMetricsConcurrency:
         # all threads raced the same event: exactly one clears the window
         assert len(accepted) == 1
         assert len(rec.events()) == 1
+
+
+class TestSolverCaches:
+    """The solver's module-level content caches (device catalog/pod-side,
+    cross-solve alternatives memo, catalog-side LRU) under concurrent
+    solves: no exceptions, correct results, bounded sizes."""
+
+    def test_concurrent_solves_share_caches_safely(self):
+        import threading
+        import numpy as np
+        from helpers import cpu_pod, small_catalog
+        from karpenter_tpu.api.objects import NodePool
+        from karpenter_tpu.ops import classpack
+        from karpenter_tpu.ops.classpack import solve_classpack
+        from karpenter_tpu.ops.tensorize import tensorize
+
+        catalogs = [small_catalog() for _ in range(3)]
+        errs = []
+        results = {}
+
+        def worker(wid):
+            try:
+                rng = np.random.default_rng(wid % 4)
+                pods = [cpu_pod(cpu_m=int(rng.integers(100, 2000)))
+                        for _ in range(50)]
+                prob = tensorize(pods, catalogs[wid % 3], [NodePool()])
+                r = solve_classpack(prob)
+                assert not r.unschedulable
+                assert sum(len(n.pod_indices) for n in r.nodes) == 50
+                results[wid] = r.total_price
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads: t.start()
+        for t in threads: t.join()
+        assert not errs, errs
+        # same seed -> same cost regardless of interleaving
+        for a in range(16):
+            for b in range(16):
+                if a % 4 == b % 4 and a % 3 == b % 3:
+                    assert results[a] == results[b]
+        # caches stay bounded
+        assert len(classpack._PODSIDE_CACHE) <= classpack._PODSIDE_CACHE_MAX
+        assert len(classpack._ALT_MEMO) <= classpack._ALT_MEMO_MAX_CATALOGS
+        assert len(classpack._CATALOG_CACHE) <= classpack._CATALOG_CACHE_MAX
