@@ -537,6 +537,10 @@ def solve_classpack(problem: Problem,
     cs_l, ce_l = cls_starts.tolist(), cls_ends.tolist()
     used_l = node_used.tolist()
     node_ckeys: List = [None] * N
+    # thread-local view of every resolved key: the shared memo can be
+    # cleared/evicted by a concurrent solve between fill and assembly, so
+    # assembly must never read it directly
+    resolved: Dict[tuple, tuple] = {}
     miss_index: Dict[tuple, int] = {}     # ckey -> row in the miss batch
     miss_nodes: List[int] = []
     miss_jc: List[np.ndarray] = []
@@ -552,10 +556,14 @@ def solve_classpack(problem: Problem,
         pool = options_l[oi].pool
         ckey = (pool, jcb.tobytes(), tuple(used_l[i]), max_alternatives)
         node_ckeys[i] = ckey
-        if ckey not in memo and ckey not in miss_index:
-            miss_index[ckey] = i
-            miss_nodes.append(i)
-            miss_jc.append(jcb)
+        if ckey not in resolved and ckey not in miss_index:
+            hit = memo.get(ckey)
+            if hit is not None:
+                resolved[ckey] = hit
+            else:
+                miss_index[ckey] = i
+                miss_nodes.append(i)
+                miss_jc.append(jcb)
 
     if miss_nodes:
         # ONE global capacity filter for every distinct miss: per-resource
@@ -577,16 +585,18 @@ def solve_classpack(problem: Problem,
             if same_pool is None:
                 same_pool = pool_masks[pool] = pool_of_option == pool
             alt_ids = np.nonzero(ok[m] & same_pool)[0][:max_alternatives]
-            memo[ckey] = ([options_l[a] for a in alt_ids],
-                          ResourceList.from_vector(np.asarray(ckey[2], np.int64),
-                                                   problem.axes, problem.scales))
+            val = ([options_l[a] for a in alt_ids],
+                   ResourceList.from_vector(np.asarray(ckey[2], np.int64),
+                                            problem.axes, problem.scales))
+            resolved[ckey] = val
+            memo[ckey] = val
 
     nodes = []
     for i in range(N):
         ckey = node_ckeys[i]
         if ckey is None:
             continue
-        hit = memo[ckey]
+        hit = resolved[ckey]
         nodes.append(NodeDecision(
             option=options_l[oi_l[i]],
             pod_indices=pod_sorted[starts_l[i]:ends_l[i]],

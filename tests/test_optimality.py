@@ -262,3 +262,48 @@ class TestLowerBounds:
         r = solve_classpack(prob)
         assert len(r.unschedulable) == 1
         assert lp <= r.total_price + 1e-6
+
+
+class TestGGBound:
+    """The configuration-LP (Gilmore-Gomory) offline certificate: always a
+    valid lower bound, at least as tight as the class-LP, and strictly
+    tighter on instances whose gap IS integrality the class-LP pools away."""
+
+    def test_gg_at_least_class_lp_and_below_plan(self):
+        from helpers import cpu_pod, small_catalog
+        from karpenter_tpu.api.objects import NodePool
+        from karpenter_tpu.ops.classpack import solve_classpack
+        from karpenter_tpu.ops.ggbound import gg_bound
+        from karpenter_tpu.ops.lpbound import class_lp_bound
+        from karpenter_tpu.ops.tensorize import tensorize
+        import numpy as np
+        rng = np.random.default_rng(7)
+        pods = [cpu_pod(cpu_m=int(rng.integers(200, 1900)),
+                        mem_mib=int(rng.integers(256, 3800)))
+                for _ in range(60)]
+        prob = tensorize(pods, small_catalog(), [NodePool()])
+        plan = solve_classpack(prob)
+        lp = class_lp_bound(prob)
+        gg, info = gg_bound(prob, iters=12, warm_plan=plan)
+        assert gg >= lp - 1e-6
+        assert plan.total_price >= gg - 1e-6     # valid lower bound
+        assert info["iters"] >= 1
+
+    def test_gg_strictly_tighter_on_integrality_gap(self):
+        """One pod needing 3 cpu on a catalog of 2- and 4-cpu nodes: the
+        class-LP pools fractional nodes (cost 3/4 of a large node); any
+        integral configuration costs a whole node — GG certifies it."""
+        from helpers import make_type
+        from karpenter_tpu.api.objects import NodePool, Pod
+        from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+        from karpenter_tpu.ops.ggbound import gg_bound
+        from karpenter_tpu.ops.lpbound import class_lp_bound
+        from karpenter_tpu.ops.tensorize import tensorize
+        cat = [make_type("s", 2, 64, 0.2), make_type("l", 4, 128, 0.4)]
+        pod = Pod(requests=ResourceList({CPU: 3000, MEMORY: 2**30}))
+        prob = tensorize([pod], cat, [NodePool()])
+        lp = class_lp_bound(prob)
+        gg, info = gg_bound(prob, iters=10)
+        assert info["converged"]
+        assert gg > lp + 1e-3                    # strictly tighter
+        assert abs(gg - 0.4) < 1e-6              # the true optimum
