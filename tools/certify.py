@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""certify — measure a packing plan's cost against certified lower bounds.
+
+Builds a synthetic workload (or reads sizes from flags), solves it with the
+production packer, and prints the plan cost against the exact class-LP
+bound (fast) and, with --gg, the tighter offline Gilmore-Gomory
+configuration-LP bound (minutes; valid at every iteration).
+
+    python tools/certify.py --pods 10000 --types 200 --specs 100 --gg
+
+See docs/design-relaxation.md for what the bounds can and cannot certify.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--types", type=int, default=200)
+    ap.add_argument("--specs", type=int, default=100,
+                    help="distinct pod shapes in the batch")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--zone-frac", type=float, default=0.3)
+    ap.add_argument("--gpu-frac", type=float, default=0.0)
+    ap.add_argument("--gg", action="store_true",
+                    help="also run the Gilmore-Gomory bound (minutes)")
+    ap.add_argument("--gg-iters", type=int, default=20)
+    ap.add_argument("--gg-time-limit", type=float, default=600.0)
+    args = ap.parse_args()
+
+    import numpy as np
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench", "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    from karpenter_tpu.api.objects import NodePool
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.ops.classpack import solve_classpack
+    from karpenter_tpu.ops.ggbound import gg_bound
+    from karpenter_tpu.ops.lpbound import class_lp_bound
+    from karpenter_tpu.ops.tensorize import tensorize
+
+    rng = np.random.default_rng(args.seed)
+    pods = bench.build_pods(args.specs, args.pods, rng,
+                            zone_frac=args.zone_frac, gpu_frac=args.gpu_frac)
+    prob = tensorize(pods, generate_catalog(args.types), [NodePool()])
+    t0 = time.perf_counter()
+    plan = solve_classpack(prob)
+    solve_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    lp = class_lp_bound(prob)
+    lp_s = time.perf_counter() - t0
+    out = {
+        "pods": args.pods, "types": args.types,
+        "plan_cost": round(plan.total_price, 2),
+        "nodes": len(plan.nodes),
+        "unschedulable": len(plan.unschedulable),
+        "solve_seconds": round(solve_s, 2),
+        "class_lp_bound": round(lp, 2),
+        "ratio_vs_class_lp": round(plan.total_price / lp, 4) if lp else None,
+        "class_lp_seconds": round(lp_s, 1),
+    }
+    if args.gg:
+        t0 = time.perf_counter()
+        gg, info = gg_bound(prob, iters=args.gg_iters,
+                            time_limit_s=args.gg_time_limit, warm_plan=plan,
+                            log=lambda s: print(s, file=sys.stderr))
+        out.update({
+            "gg_bound": round(gg, 2),
+            "ratio_vs_gg": round(plan.total_price / gg, 4) if gg else None,
+            "gg_converged": info["converged"],
+            "gg_iters": info["iters"],
+            "gg_seconds": round(time.perf_counter() - t0, 1),
+        })
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
