@@ -35,6 +35,12 @@ from .tensorize import LaunchOption, Problem, pad_to
 
 _BIG = np.int32(2**30)
 
+# one lock for all module caches: check-then-insert must be atomic or
+# concurrent misses overshoot the size caps (the ops are once-per-solve,
+# so the lock costs nothing against a device dispatch)
+import threading
+_CACHE_LOCK = threading.Lock()
+
 
 @partial(jax.jit, static_argnames=("max_nodes", "emit_takes"))
 def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
@@ -284,11 +290,12 @@ def _device_podside(req_p: np.ndarray, cnt_p: np.ndarray,
     hit = _PODSIDE_CACHE.get(key)
     if hit is not None:
         return hit
-    if len(_PODSIDE_CACHE) >= _PODSIDE_CACHE_MAX:
-        _PODSIDE_CACHE.pop(next(iter(_PODSIDE_CACHE)), None)
     val = (jnp.asarray(req_p), jnp.asarray(cnt_p), jnp.asarray(packed),
            jnp.asarray(cap_p))
-    _PODSIDE_CACHE[key] = val
+    with _CACHE_LOCK:
+        while len(_PODSIDE_CACHE) >= _PODSIDE_CACHE_MAX:
+            _PODSIDE_CACHE.pop(next(iter(_PODSIDE_CACHE)), None)
+        _PODSIDE_CACHE[key] = val
     return val
 
 
@@ -312,11 +319,15 @@ def _alt_memo_for(problem: Problem) -> dict:
         if len(hit[1]) > _ALT_MEMO_MAX_ENTRIES:
             hit[1].clear()
         return hit[1]
-    if len(_ALT_MEMO) >= _ALT_MEMO_MAX_CATALOGS:
-        _ALT_MEMO.pop(next(iter(_ALT_MEMO)), None)
-    entries: dict = {}
-    _ALT_MEMO[key] = (problem.options, entries)
-    return entries
+    with _CACHE_LOCK:
+        hit = _ALT_MEMO.get(key)
+        if hit is not None and hit[0] is problem.options:
+            return hit[1]
+        while len(_ALT_MEMO) >= _ALT_MEMO_MAX_CATALOGS:
+            _ALT_MEMO.pop(next(iter(_ALT_MEMO)), None)
+        entries: dict = {}
+        _ALT_MEMO[key] = (problem.options, entries)
+        return entries
 
 
 def _device_catalog(alloc: np.ndarray, price: np.ndarray, rank: np.ndarray):
@@ -328,10 +339,11 @@ def _device_catalog(alloc: np.ndarray, price: np.ndarray, rank: np.ndarray):
     hit = _CATALOG_CACHE.get(key)
     if hit is not None:
         return hit
-    if len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
-        _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)), None)
     val = (jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank))
-    _CATALOG_CACHE[key] = val
+    with _CACHE_LOCK:
+        while len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
+            _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)), None)
+        _CATALOG_CACHE[key] = val
     return val
 
 

@@ -417,6 +417,8 @@ class _CatalogSide:
 # upper layers memoizing their catalog lists.
 _CATSIDE_CACHE: Dict[tuple, _CatalogSide] = {}
 _CATSIDE_MAX = 8
+import threading as _threading
+_CATSIDE_LOCK = _threading.Lock()
 
 
 def _catside_fingerprint(catalog: Sequence[InstanceType],
@@ -461,12 +463,14 @@ def catalog_side(catalog: Sequence[InstanceType],
     key = _catside_fingerprint(catalog, nodepools, axes, scales, node_classes)
     side = _CATSIDE_CACHE.get(key)
     if side is None:
-        if len(_CATSIDE_CACHE) >= _CATSIDE_MAX:
-            _CATSIDE_CACHE.pop(next(iter(_CATSIDE_CACHE)), None)
         side = _CatalogSide(catalog, nodepools, axes, scales, node_classes)
-    else:
-        _CATSIDE_CACHE.pop(key)  # re-insert: eviction order becomes LRU
-    _CATSIDE_CACHE[key] = side
+    with _CATSIDE_LOCK:
+        # atomic size-capped LRU re-insert (concurrent misses would
+        # otherwise overshoot the cap)
+        _CATSIDE_CACHE.pop(key, None)
+        while len(_CATSIDE_CACHE) >= _CATSIDE_MAX:
+            _CATSIDE_CACHE.pop(next(iter(_CATSIDE_CACHE)), None)
+        _CATSIDE_CACHE[key] = side
     return side
 
 
