@@ -566,8 +566,14 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
     )
 
 
-def pad_to(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)) -> int:
-    """Bucketed padding to bound jit recompiles (SURVEY.md §7 hard part iv)."""
+def pad_to(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 32768,
+                                             53248, 65536)) -> int:
+    """Bucketed padding to bound jit recompiles (SURVEY.md §7 hard part iv).
+
+    The 32k/52k steps exist because padded size is TRANSFER: the decode
+    ships one int16 per padded pod row, and on tunneled dev TPUs every
+    byte of result payload is latency — a 50k batch padded to 64k would
+    pay a quarter more fetch for nothing."""
     for b in buckets:
         if n <= b:
             return b
