@@ -613,3 +613,113 @@ def crd_schemas() -> Dict[str, Dict]:
             },
         },
     }
+
+
+# ---------------------------------------------------------------------------
+# Pod (k8s PodSpec subset — what the scheduler consumes)
+# ---------------------------------------------------------------------------
+
+def pod_from_manifest(m: Dict) -> "Pod":
+    """k8s Pod manifest → scheduling Pod.  Parses exactly the surface the
+    solver honors (the reference's constraint inventory,
+    /root/reference/website/content/en/docs/concepts/scheduling.md):
+    container resource requests (summed; init containers take the max),
+    nodeSelector, required/preferred node affinity, tolerations, topology
+    spread, pod (anti-)affinity, priority, pod-deletion-cost and
+    do-not-disrupt annotations, owner references."""
+    from .objects import Pod, PodAffinityTerm, TopologySpreadConstraint
+    meta = m.get("metadata", {})
+    spec = m.get("spec", {})
+
+    req = ResourceList()
+    for c in spec.get("containers", []):
+        req = req + ResourceList.parse(
+            c.get("resources", {}).get("requests", {}) or {})
+    for c in spec.get("initContainers", []):
+        ireq = ResourceList.parse(
+            c.get("resources", {}).get("requests", {}) or {})
+        for k, v in ireq.items():
+            req[k] = max(req.get(k, 0), v)
+
+    required_terms: List[Requirements] = []
+    preferred_terms: List = []
+    aff = spec.get("affinity", {}) or {}
+    node_aff = aff.get("nodeAffinity", {}) or {}
+    hard = node_aff.get(
+        "requiredDuringSchedulingIgnoredDuringExecution", {}) or {}
+    for term in hard.get("nodeSelectorTerms", []):
+        reqs = Requirements.of(*[requirement_from_dict(e)
+                                 for e in term.get("matchExpressions", [])])
+        required_terms.append(reqs)
+    for pref in node_aff.get(
+            "preferredDuringSchedulingIgnoredDuringExecution", []) or []:
+        reqs = Requirements.of(*[
+            requirement_from_dict(e)
+            for e in pref.get("preference", {}).get("matchExpressions", [])])
+        preferred_terms.append((int(pref.get("weight", 1)), reqs))
+
+    def _match_labels(sel: Dict, where: str) -> Dict[str, str]:
+        # the model's selectors are matchLabels maps; silently parsing an
+        # expressions-based selector as {} would mean "match every pod in
+        # the namespace" — refuse instead of misschedule
+        if sel.get("matchExpressions"):
+            raise ValueError(
+                f"labelSelector.matchExpressions not supported ({where})")
+        return dict(sel.get("matchLabels", {}))
+
+    pod_affinities: List = []
+    for kind, anti in (("podAffinity", False), ("podAntiAffinity", True)):
+        block = aff.get(kind, {}) or {}
+        for term in block.get(
+                "requiredDuringSchedulingIgnoredDuringExecution", []) or []:
+            pod_affinities.append(PodAffinityTerm(
+                topology_key=term.get("topologyKey", ""),
+                label_selector=_match_labels(
+                    term.get("labelSelector", {}) or {}, kind),
+                anti=anti, required=True))
+        for pref in block.get(
+                "preferredDuringSchedulingIgnoredDuringExecution", []) or []:
+            term = pref.get("podAffinityTerm", {})
+            pod_affinities.append(PodAffinityTerm(
+                topology_key=term.get("topologyKey", ""),
+                label_selector=_match_labels(
+                    term.get("labelSelector", {}) or {}, kind),
+                anti=anti, required=False))
+
+    spreads = [TopologySpreadConstraint(
+        topology_key=t.get("topologyKey", ""),
+        max_skew=int(t.get("maxSkew", 1)),
+        when_unsatisfiable=t.get("whenUnsatisfiable", "DoNotSchedule"),
+        label_selector=_match_labels(t.get("labelSelector", {}) or {},
+                                     "topologySpreadConstraints"),
+        min_domains=t.get("minDomains"))
+        for t in spec.get("topologySpreadConstraints", []) or []]
+
+    annotations = dict(meta.get("annotations", {}))
+    owners = meta.get("ownerReferences", []) or []
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        requests=req,
+        node_selector=dict(spec.get("nodeSelector", {}) or {}),
+        required_affinity_terms=required_terms,
+        preferred_affinity_terms=preferred_terms,
+        tolerations=[_toleration_from_dict(t)
+                     for t in spec.get("tolerations", []) or []],
+        topology_spread=spreads,
+        pod_affinities=pod_affinities,
+        labels=dict(meta.get("labels", {})),
+        annotations=annotations,
+        priority=int(spec.get("priority", 0) or 0),
+        deletion_cost=int(annotations.get(
+            "controller.kubernetes.io/pod-deletion-cost", 0) or 0),
+        owner_kind=(owners[0].get("kind", "") if owners else ""),
+    )
+
+
+def _toleration_from_dict(d: Dict):
+    from .taints import Toleration
+    return Toleration(key=d.get("key", ""),
+                      operator=d.get("operator", "Equal"),
+                      value=d.get("value", ""),
+                      effect=d.get("effect", ""))

@@ -171,6 +171,10 @@ class ControllerManager:
                 name, reconcile, self.DEFAULT_INTERVALS.get(name, 10.0)))
         self._stop = threading.Event()
         self._http: Optional[http.server.ThreadingHTTPServer] = None
+        # serializes cluster-state access between the tick loop and the
+        # /v1/solve HTTP worker threads (controllers mutate cluster.nodes
+        # and gauge bookkeeping mid-tick)
+        self._state_lock = threading.Lock()
 
     def _nodeclass_tick(self, ctrl):
         def run():
@@ -187,6 +191,10 @@ class ControllerManager:
             self.leader.try_acquire()
             if not self.leader.is_leader():
                 return {}
+        with self._state_lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> Dict[str, object]:
         now = self.clock()
         results: Dict[str, object] = {}
         prov = self.controllers.get("provisioning")
@@ -221,6 +229,61 @@ class ControllerManager:
             self._http.shutdown()
 
     # ------------------------------------------------------------------
+    def solve_request(self, payload: Dict) -> Dict:
+        """One stateless solve for the /v1/solve seam: k8s Pod manifests in,
+        launch plan out.  `schedule_on_existing` (default true) packs
+        against live cluster capacity first, like the provisioner does.
+        Serialized against the tick loop (controllers mutate cluster state
+        and gauge bookkeeping mid-tick); placements failing the post-solve
+        batch-topology audit are reported as `deferred`, exactly the pods
+        the internal path would strand and re-solve."""
+        from ..api.serialize import pod_from_manifest
+        from ..ops.constraints import find_batch_topology_violations
+        prov = self.controllers.get("provisioning")
+        if prov is None:
+            raise ValueError("no provisioning controller wired")
+        pods = [pod_from_manifest(p) for p in payload.get("pods", [])]
+        if not pods:
+            raise ValueError("no pods in request")
+        with self._state_lock:
+            problem, packing = prov.solve(
+                pods, schedule_on_existing=bool(
+                    payload.get("scheduleOnExisting", True)))
+        stranded = set(find_batch_topology_violations(
+            problem, packing, packing._existing_nodes))
+        nodes = []
+        for nd in packing.nodes:
+            keep = [i for i in nd.pod_indices if i not in stranded]
+            if not keep:
+                continue
+            nodes.append({
+                "instanceType": nd.option.instance_type,
+                "zone": nd.option.zone,
+                "capacityType": nd.option.capacity_type,
+                "nodepool": nd.option.pool,
+                "pods": [problem.pods[i].name for i in keep],
+                "alternatives": [
+                    {"instanceType": a.instance_type, "zone": a.zone,
+                     "capacityType": a.capacity_type}
+                    for a in nd.alternatives[:20]],
+            })
+        bound = [{"pod": problem.pods[i].name,
+                  "node": packing._existing_nodes[slot].name}
+                 for i, slot in packing.existing_assignments.items()
+                 if i not in stranded]
+        return {
+            "nodes": nodes,
+            "boundToExisting": bound,
+            "unschedulable": [problem.pods[i].name
+                              for i in packing.unschedulable
+                              if i is not None],
+            # batch-internal anti-affinity/spread carriers: re-request these
+            # after binding the rest (the in-process provisioner does the
+            # same strand-and-resolve)
+            "deferred": [problem.pods[i].name for i in sorted(stranded)],
+            "totalPricePerHour": round(packing.total_price, 4),
+        }
+
     def serve_endpoints(self, metrics_port: Optional[int] = None,
                         health_port: Optional[int] = None):
         """Start /metrics + /healthz + /readyz on a background thread.
@@ -267,6 +330,36 @@ class ControllerManager:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                """POST /v1/solve — the external-integration seam
+                (SURVEY §7.8): an out-of-process controller (e.g. a Go
+                control plane running against a real apiserver) ships k8s
+                Pod manifests and receives the TPU solve's launch plan.
+                Stateless: solves against the operator's live catalog and
+                pools without binding anything."""
+                if self.path != "/v1/solve":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    body = json.dumps(
+                        manager.solve_request(payload)).encode()
+                    code = 200
+                except (ValueError, KeyError, TypeError) as e:
+                    # malformed request — the client should fix and resend
+                    body = json.dumps({"error": str(e)}).encode()
+                    code = 400
+                except Exception as e:   # server fault — client may retry
+                    log.exception("solve request failed")
+                    body = json.dumps({"error": str(e)}).encode()
+                    code = 500
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
                 self.end_headers()
                 self.wfile.write(body)
 

@@ -170,6 +170,49 @@ class TestControllerManager:
         finally:
             mgr.stop()
 
+    def test_solve_endpoint_returns_launch_plan(self):
+        """POST /v1/solve: k8s Pod manifests in, launch plan out — the
+        external-integration seam (SURVEY §7.8)."""
+        import json as _json
+        clock = [100.0]
+        op = self._operator(clock)
+        ctrls = build_controllers(op)
+        mgr = ControllerManager(op, ctrls, clock=lambda: clock[0])
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            for nc in op.node_classes.values():
+                ctrls["nodeclass"].reconcile(nc)
+            payload = _json.dumps({"pods": [
+                {"metadata": {"name": f"p{i}"},
+                 "spec": {"containers": [{"resources": {"requests": {
+                     "cpu": "500m", "memory": "512Mi"}}}]}}
+                for i in range(6)]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/solve", data=payload,
+                headers={"Content-Type": "application/json"})
+            out = _json.loads(urllib.request.urlopen(req, timeout=10).read())
+            assert not out["unschedulable"]
+            placed = sum(len(n["pods"]) for n in out["nodes"]) + \
+                len(out["boundToExisting"])
+            assert placed == 6
+            assert out["totalPricePerHour"] > 0
+            assert out["nodes"][0]["instanceType"]
+            assert out["nodes"][0]["alternatives"]
+            # solve is stateless: nothing bound, nothing launched
+            assert not op.cluster.nodes
+            # malformed request -> 400 with an error body, not a crash
+            bad = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/solve", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "error" in _json.loads(e.read())
+        finally:
+            mgr.stop()
+
     def test_leader_election_gates_ticks(self, tmp_path):
         clock = [100.0]
         lease = str(tmp_path / "lease.json")
