@@ -48,6 +48,7 @@ from ..ops.ffd import PackingResult
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
 from ..utils import metrics
+from ..utils.events import Event
 
 log = logging.getLogger("karpenter_tpu.disruption")
 
@@ -129,12 +130,15 @@ class DisruptionController:
                  drift_enabled: bool = True,
                  max_candidates: int = 64,
                  terminator: Optional["TerminationController"] = None,
-                 spot_min_flexibility: int = SPOT_TO_SPOT_MIN_ALTERNATIVES):
+                 spot_min_flexibility: int = SPOT_TO_SPOT_MIN_ALTERNATIVES,
+                 recorder=None):
+        from ..utils.events import Recorder
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
         self.clock = clock
         self.terminator = terminator
+        self.recorder = recorder or Recorder(log=False)
         self.stabilization_s = stabilization_s
         self.drift_enabled = drift_enabled
         self.max_candidates = max_candidates
@@ -158,15 +162,26 @@ class DisruptionController:
                 continue  # min node lifetime
             if node.nominated_until > now:
                 continue  # in-flight pod nomination
-            blocked = False
+            blocked = ""
             for p in node.pods:
-                if p.do_not_disrupt or not p.owner_kind:
-                    blocked = True
+                if p.do_not_disrupt:
+                    blocked = f"pod {p.name} has do-not-disrupt"
+                    break
+                if not p.owner_kind:
+                    blocked = f"pod {p.name} is ownerless"
                     break
             if blocked:
+                # reference emits Unconsolidatable events so operators see
+                # why capacity stays up; the recorder's dedupe window keeps
+                # the per-tick republish quiet
+                self.recorder.publish(Event(
+                    "Node", node.name, "Unconsolidatable", blocked))
                 continue
             resched = [p for p in node.pods if not p.is_daemon]
             if not self.cluster.evictable(resched, budgets):
+                self.recorder.publish(Event(
+                    "Node", node.name, "Unconsolidatable",
+                    "pod disruption budget exhausted"))
                 continue  # PDB budget exhausted
             claim = self.cluster.claim_for_provider_id(node.provider_id)
             out.append(Candidate(
@@ -545,6 +560,9 @@ class DisruptionController:
                     # (website/.../concepts/disruption.md:12-14)
                     metrics.disruption_replacement_failures().inc(
                         {"method": action.reason})
+                    self.recorder.publish(Event(
+                        "Node", action.candidates[0].name, "DisruptionFailed",
+                        f"replacement launch failed: {e}", type="Warning"))
                     log.warning("disruption rollback, launch failed: %s", e)
                     self._rollback(action, new_nodes, out)
                     out.error = str(e)
@@ -614,6 +632,9 @@ class DisruptionController:
             out.deleted.append(c.name)
             metrics.nodeclaims_disrupted().inc(
                 {"type": action.reason, "nodepool": c.node.nodepool or ""})
+            self.recorder.publish(Event(
+                "Node", c.name, "DisruptionTerminating",
+                f"{action.kind} via {action.reason}"))
         log.info("disruption %s: deleted %s, launched %s", action.name,
                  out.deleted, [c.name for c in out.launched])
         return out

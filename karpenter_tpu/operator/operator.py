@@ -254,7 +254,8 @@ def build_controllers(op: Operator) -> Dict[str, object]:
         "disruption": DisruptionController(
             op.cloud_provider, op.cluster, op.nodepools,
             terminator=terminator, clock=op.clock,
-            drift_enabled=op.options.gate("Drift")),
+            drift_enabled=op.options.gate("Drift"),
+            recorder=op.recorder),
         "lifecycle": LifecycleController(
             op.cloud_provider, op.cluster, nodepools=op.nodepools,
             recorder=op.recorder, clock=op.clock),

@@ -569,3 +569,42 @@ def test_consolidation_probes_use_aggregate_kernel():
     # probes were aggregate; exactly one decoded solve for the action
     assert False in calls
     assert calls.count(True) == 1
+
+
+def test_disruption_events_published():
+    """Blocked candidates surface Unconsolidatable with the blocker reason;
+    executed actions surface DisruptionTerminating (reference event
+    parity — operators must see WHY capacity stays up)."""
+    from helpers import cpu_pod, small_catalog
+    from karpenter_tpu.api.objects import Disruption, NodePool
+    from karpenter_tpu.cloud import CloudProvider, FakeCloud
+    from karpenter_tpu.controllers import Provisioner
+    from karpenter_tpu.controllers.disruption import DisruptionController
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils.events import Recorder
+
+    clock = [1000.0]
+    cloud = FakeCloud(lambda: clock[0])
+    provider = CloudProvider(cloud, small_catalog(), clock=lambda: clock[0])
+    cluster = Cluster(lambda: clock[0])
+    pools = [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized"))]
+    prov = Provisioner(provider, cluster, pools, clock=lambda: clock[0])
+    cluster.add_pods([cpu_pod(cpu_m=300)])
+    prov.provision()
+    from karpenter_tpu.api.objects import Pod
+    blocked_pod = cpu_pod(cpu_m=300,
+                          annotations={Pod.DO_NOT_DISRUPT: "true"})
+    cluster.add_pods([blocked_pod])
+    prov.provision([p for p in cluster.pods.values() if not p.node_name])
+    rec = Recorder(clock=lambda: clock[0], log=False)
+    ctrl = DisruptionController(provider, cluster, pools,
+                                clock=lambda: clock[0], stabilization_s=0.0,
+                                recorder=rec)
+    res = ctrl.reconcile()
+    reasons = {e.reason for e in rec.events()}
+    assert "Unconsolidatable" in reasons
+    blocked = rec.events("Unconsolidatable")
+    assert any("do-not-disrupt" in e.message for e in blocked)
+    if res.deleted:
+        assert "DisruptionTerminating" in reasons
