@@ -213,6 +213,58 @@ class TestControllerManager:
         finally:
             mgr.stop()
 
+    def test_solve_endpoint_concurrent_with_tick_loop(self):
+        """/v1/solve is serialized with the tick loop: hammering the
+        endpoint while controllers churn cluster state must never surface
+        an iteration/bookkeeping race (each request still gets a plan)."""
+        import json as _json
+        import threading as _threading
+        clock = [100.0]
+        op = self._operator(clock)
+        ctrls = build_controllers(op)
+        mgr = ControllerManager(op, ctrls, clock=lambda: clock[0])
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            for nc in op.node_classes.values():
+                ctrls["nodeclass"].reconcile(nc)
+            stop = _threading.Event()
+            tick_errs = []
+
+            def churn():
+                i = 0
+                while not stop.is_set():
+                    # keep pending work arriving so provisioning mutates
+                    # cluster state on most ticks
+                    op.cluster.add_pods([pod(cpu=100)])
+                    clock[0] += 2.0
+                    try:
+                        mgr.tick()
+                    except Exception as e:  # pragma: no cover
+                        tick_errs.append(repr(e))
+                    i += 1
+
+            t = _threading.Thread(target=churn)
+            t.start()
+            payload = _json.dumps({"pods": [
+                {"metadata": {"name": f"q{i}"},
+                 "spec": {"containers": [{"resources": {"requests": {
+                     "cpu": "200m", "memory": "128Mi"}}}]}}
+                for i in range(4)]}).encode()
+            codes = []
+            for _ in range(25):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/solve", data=payload,
+                    headers={"Content-Type": "application/json"})
+                out = _json.loads(
+                    urllib.request.urlopen(req, timeout=30).read())
+                codes.append(len(out["nodes"]) + len(out["boundToExisting"]))
+            stop.set()
+            t.join()
+            assert not tick_errs, tick_errs
+            assert all(c >= 1 for c in codes)   # every request got a plan
+        finally:
+            mgr.stop()
+
     def test_leader_election_gates_ticks(self, tmp_path):
         clock = [100.0]
         lease = str(tmp_path / "lease.json")
