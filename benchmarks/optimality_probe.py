@@ -1,0 +1,193 @@
+"""Offline optimality probe — settles VERDICT r4's open question #1.
+
+Measures, on the bench's own mixed-shape instances:
+  1. the greedy plan cost and its per-node utilization/waste breakdown;
+  2. a certified bracket [lb, ub] on the EXACT integral packing optimum
+     (column generation + integer restricted master, ops/ggbound.py
+     `integral_bracket`) — ub is a real fleet, so plan/ub lower-bounds
+     true packer waste and ub/lb bounds how loose the LP certificate is;
+  3. a repack-repair trial: drop nodes below a utilization threshold,
+     re-solve their pods against the survivors' free space, measure the
+     cost delta and wall time — the candidate product-path repair.
+
+Usage:  JAX_PLATFORMS=cpu python benchmarks/optimality_probe.py [config...]
+Configs: 10k-mixed 50k-burst (default: 10k-mixed)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def build_instance(name):
+    """Replay bench.py's rng sequence so the instance is bit-identical to
+    the published BENCH numbers."""
+    import bench
+    from karpenter_tpu.api.objects import NodePool
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.ops.tensorize import tensorize
+
+    rng = np.random.default_rng(42)
+    p1k = bench.build_pods(1, 1000, rng)
+    p10k = bench.build_pods(100, 10_000, rng, zone_frac=0.3)
+    p5k = bench.build_pods(40, 5_000, rng, gpu_frac=1.0)
+    p50k = bench.build_pods(200, 50_000, rng, gpu_frac=0.05, zone_frac=0.2,
+                            taint_frac=0.1)
+    pods, n_types = {
+        "1k-homogeneous": (p1k, 10),
+        "10k-mixed": (p10k, 200),
+        "5k-gpu": (p5k, 600),
+        "50k-burst": (p50k, 600),
+    }[name]
+    catalog = generate_catalog(n_types)
+    return tensorize(pods, catalog, [NodePool()])
+
+
+def _node_fills(prob, plan):
+    """[(option_index, node, used_vector, bottleneck_util)] for every node —
+    the shared per-node accounting waste_breakdown and repair_trial use."""
+    alloc = prob.option_alloc
+    opt_index = {id(o): j for j, o in enumerate(prob.options)}
+    out = []
+    for nd in plan.nodes:
+        oi = opt_index[id(nd.option)]
+        a = alloc[oi].astype(np.float64)
+        used = np.zeros_like(a)
+        for p in nd.pod_indices:
+            used += prob.class_requests[_class_of(prob, p)]
+        util = float(np.max(np.where(a > 0, used / np.where(a > 0, a, 1), 0)))
+        out.append((oi, nd, used, util))
+    return out
+
+
+def waste_breakdown(prob, plan):
+    """Where does the plan's cost sit relative to its own fills?"""
+    rows = np.array([(nd.option.price, util, len(nd.pod_indices))
+                     for _, nd, _, util in _node_fills(prob, plan)])
+    total = rows[:, 0].sum()
+    for lo, hi in [(0, .25), (.25, .5), (.5, .75), (.75, .9), (.9, 1.01)]:
+        m = (rows[:, 1] >= lo) & (rows[:, 1] < hi)
+        print(f"  util [{lo:.2f},{hi:.2f}): nodes={int(m.sum()):5d} "
+              f"cost=${rows[m, 0].sum():8.2f} ({100*rows[m,0].sum()/total:.1f}%)",
+              flush=True)
+    return rows
+
+
+_class_cache = {}
+
+
+def _class_of(prob, p):
+    key = id(prob)
+    m = _class_cache.get(key)
+    if m is None:
+        m = {}
+        for ci, mem in enumerate(prob.class_members):
+            for q in np.asarray(mem):
+                m[int(q)] = ci
+        _class_cache[key] = m
+    return m[p]
+
+
+def repair_trial(prob, plan, tau=0.7):
+    """Drop nodes with bottleneck-utilization < tau; re-pack their pods
+    against the survivors' free space (existing columns, price=+inf)."""
+    from karpenter_tpu.ops.classpack import solve_classpack
+
+    alloc = prob.option_alloc
+    survivors, victims = [], []
+    for oi, nd, used, util in _node_fills(prob, plan):
+        (survivors if util >= tau else victims).append((oi, nd, used))
+    if not victims:
+        print(f"  tau={tau}: no victims")
+        return plan.total_price
+    # subproblem: victim pods, survivors as existing capacity
+    vic_pods = [p for _, nd, _ in victims for p in nd.pod_indices]
+    ex_alloc = np.stack([alloc[oi] for oi, _, _ in survivors]) \
+        if survivors else None
+    ex_used = np.stack([u for _, _, u in survivors]) if survivors else None
+    # build a sub-problem over the victim pods only
+    sub_counts = {}
+    for p in vic_pods:
+        sub_counts[_class_of(prob, p)] = sub_counts.get(_class_of(prob, p), 0) + 1
+    cls = sorted(sub_counts)
+    sub = _subproblem(prob, cls, sub_counts)
+    ex_compat = prob.class_compat[cls][:, [oi for oi, _, _ in survivors]] \
+        if survivors else None
+    # existing-node compat: victim-class pod may land on a survivor only if
+    # compatible with that survivor's option
+    t0 = time.perf_counter()
+    r = solve_classpack(sub, existing_alloc=ex_alloc, existing_used=ex_used,
+                        existing_compat=ex_compat, decode=True)
+    dt = (time.perf_counter() - t0) * 1000
+    surv_cost = sum(prob.options[oi].price for oi, _, _ in survivors)
+    new_cost = surv_cost + r.total_price
+    print(f"  tau={tau}: victims={len(victims)} nodes "
+          f"(${plan.total_price - surv_cost:.2f}) -> repacked "
+          f"${r.total_price:.2f} + unsched={len(r.unschedulable)} "
+          f"total ${new_cost:.2f} (was ${plan.total_price:.2f}) "
+          f"[{dt:.0f}ms]", flush=True)
+    return new_cost
+
+
+def _subproblem(prob, cls, sub_counts):
+    """A Problem restricted to the given classes with the given counts."""
+    import copy
+    sub = copy.copy(prob)
+    sub.class_requests = prob.class_requests[cls]
+    sub.class_counts = np.array([sub_counts[c] for c in cls], np.int32)
+    sub.class_compat = prob.class_compat[cls]
+    if prob.class_node_cap is not None:
+        sub.class_node_cap = prob.class_node_cap[cls]
+    # fake member lists (indices don't matter for cost accounting)
+    off = 0
+    members = []
+    for c in cls:
+        members.append(np.arange(off, off + sub_counts[c], dtype=np.int64))
+        off += sub_counts[c]
+    sub.class_members = members
+    sub.__dict__.pop("_members_arr", None)
+    return sub
+
+
+def main():
+    configs = sys.argv[1:] or ["10k-mixed"]
+    from karpenter_tpu.ops.classpack import solve_classpack
+    from karpenter_tpu.ops.ggbound import integral_bracket
+    from karpenter_tpu.ops.lpbound import class_lp_bound
+
+    for name in configs:
+        print(f"=== {name} ===", flush=True)
+        prob = build_instance(name)
+        t0 = time.perf_counter()
+        plan = solve_classpack(prob)
+        print(f"plan: nodes={len(plan.nodes)} cost=${plan.total_price:.2f} "
+              f"unsched={len(plan.unschedulable)} "
+              f"[{(time.perf_counter()-t0)*1000:.0f}ms]", flush=True)
+        waste_breakdown(prob, plan)
+        for tau in (0.5, 0.7, 0.85):
+            repair_trial(prob, plan, tau)
+        t0 = time.perf_counter()
+        lp = class_lp_bound(prob)
+        if lp is None:
+            print(f"class-LP lb: unavailable (LP failed or timed out) "
+                  f"[{time.perf_counter()-t0:.0f}s]", flush=True)
+        else:
+            print(f"class-LP lb=${lp:.2f} (plan x{plan.total_price/lp:.4f}) "
+                  f"[{time.perf_counter()-t0:.0f}s]", flush=True)
+        t0 = time.perf_counter()
+        lb, ub, info = integral_bracket(
+            prob, iters=25, time_limit_s=900.0, master_time_limit_s=300.0,
+            warm_plan=plan, log=lambda m: print("  " + m, flush=True))
+        print(f"bracket: lb=${lb:.2f} ub=${ub:.2f} (ub/lb x{ub/lb:.4f}) "
+              f"plan x{plan.total_price/lb:.4f} vs lb, "
+              f"x{plan.total_price/ub:.4f} vs ub "
+              f"[{time.perf_counter()-t0:.0f}s] {info}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -160,12 +160,30 @@ def merge(*lists: Mapping[str, int]) -> ResourceList:
 
 
 def pod_requests(containers: Iterable[Mapping[str, int]],
-                 init_containers: Iterable[Mapping[str, int]] = ()) -> ResourceList:
-    """Effective pod request = max(sum(containers), max(initContainers)) per
-    resource — standard K8s semantics the reference's scheduler packs with."""
-    total = merge(*containers)
-    out = ResourceList(total)
-    for ic in init_containers:
-        for k, v in ic.items():
+                 init_containers: Iterable = ()) -> ResourceList:
+    """Effective pod request under K8s + KEP-753 (sidecar) semantics — the
+    single source of truth `serialize.pod_from_manifest` delegates to:
+
+        max( sum(containers) + sum(sidecars),
+             max_i( init_i + sidecars started before init_i ) )
+
+    `init_containers` items are either a plain requests mapping (one-shot
+    init container) or a `(requests, restart_always)` pair; items with
+    `restart_always=True` are sidecars, which run for the pod's whole
+    lifetime and therefore ADD to both the init-phase peak and the steady
+    state, in list order."""
+    def _emax(a: ResourceList, b: Mapping[str, int]) -> ResourceList:
+        out = ResourceList(a)
+        for k, v in b.items():
             out[k] = max(out.get(k, 0), v)
-    return out
+        return out
+
+    app = merge(*containers)
+    sidecars = ResourceList()   # sidecars started so far, in list order
+    init_peak = ResourceList()  # element-wise max over init steps
+    for ic in init_containers:
+        req, always = ic if isinstance(ic, tuple) else (ic, False)
+        init_peak = _emax(init_peak, sidecars + ResourceList(req))
+        if always:
+            sidecars = sidecars + ResourceList(req)
+    return _emax(app + sidecars, init_peak)

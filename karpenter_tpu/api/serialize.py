@@ -623,23 +623,36 @@ def pod_from_manifest(m: Dict) -> "Pod":
     """k8s Pod manifest → scheduling Pod.  Parses exactly the surface the
     solver honors (the reference's constraint inventory,
     /root/reference/website/content/en/docs/concepts/scheduling.md):
-    container resource requests (summed; init containers take the max),
-    nodeSelector, required/preferred node affinity, tolerations, topology
-    spread, pod (anti-)affinity, priority, pod-deletion-cost and
-    do-not-disrupt annotations, owner references."""
+    container resource requests (summed; requests default from limits as
+    k8s admission does; plain init containers take the max while sidecar
+    init containers — restartPolicy: Always, which run for the pod's whole
+    lifetime — are summed with the app containers), nodeSelector,
+    required/preferred node affinity, tolerations, topology spread, pod
+    (anti-)affinity, priority, pod-deletion-cost and do-not-disrupt
+    annotations, owner references."""
     from .objects import Pod, PodAffinityTerm, TopologySpreadConstraint
     meta = m.get("metadata", {})
     spec = m.get("spec", {})
 
-    req = ResourceList()
-    for c in spec.get("containers", []):
-        req = req + ResourceList.parse(
-            c.get("resources", {}).get("requests", {}) or {})
-    for c in spec.get("initContainers", []):
-        ireq = ResourceList.parse(
-            c.get("resources", {}).get("requests", {}) or {})
-        for k, v in ireq.items():
-            req[k] = max(req.get(k, 0), v)
+    def _requests(c: Dict) -> "ResourceList":
+        # kube-apiserver defaults requests from limits PER RESOURCE NAME
+        # when a request is absent (advisor r4): a raw manifest relying on
+        # that default must not under-request vs what the kubelet enforces
+        res = c.get("resources", {}) or {}
+        creq = ResourceList.parse(res.get("requests") or {})
+        for k, v in ResourceList.parse(res.get("limits") or {}).items():
+            if k not in creq:
+                creq[k] = v
+        return creq
+
+    # KEP-753 effective request, delegated to the shared single source of
+    # truth (resources.pod_requests): sidecars ADD to both the init-phase
+    # peak and the steady state; one-shot inits only shape the peak
+    from .resources import pod_requests
+    req = pod_requests(
+        [_requests(c) for c in spec.get("containers", [])],
+        [(_requests(c), c.get("restartPolicy") == "Always")
+         for c in spec.get("initContainers", [])])
 
     required_terms: List[Requirements] = []
     preferred_terms: List = []

@@ -486,6 +486,52 @@ class TestPodFromManifest:
         assert p.owner_kind == "StatefulSet"
         assert p.do_not_disrupt
 
+    def test_requests_default_from_limits(self):
+        """kube-apiserver defaults requests from limits at admission; a raw
+        manifest relying on that must not under-request here (advisor r4)."""
+        from karpenter_tpu.api.serialize import pod_from_manifest
+        p = pod_from_manifest({
+            "metadata": {"name": "x"},
+            "spec": {"containers": [
+                {"resources": {"limits": {"cpu": "2", "memory": "1Gi"}}},
+                {"resources": {"requests": {"cpu": "500m"},
+                               "limits": {"cpu": "4", "memory": "2Gi"}}}]}})
+        # explicit requests win; absent requests fall back to limits PER
+        # RESOURCE NAME — the second container's memory defaults from its
+        # limit even though it declares a cpu request
+        assert p.requests["cpu"] == 2500
+        assert p.requests["memory"] == 3 * 2**30
+
+    def test_sidecar_init_containers_sum(self):
+        """restartPolicy: Always init containers (sidecars, KEP-753) run for
+        the pod's lifetime — their requests ADD to the steady-state
+        footprint instead of max'ing like one-shot init containers."""
+        from karpenter_tpu.api.serialize import pod_from_manifest
+        p = pod_from_manifest({
+            "metadata": {"name": "x"},
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"cpu": "1"}}}],
+                "initContainers": [
+                    {"restartPolicy": "Always",
+                     "resources": {"requests": {"cpu": "500m"}}},
+                    {"resources": {"requests": {"cpu": "1200m"}}}]}})
+        # effective = max(app + sidecars, max_i(init_i + sidecars before i))
+        #           = max(1000 + 500, 1200 + 500) = 1700
+        assert p.requests["cpu"] == 1700
+
+    def test_init_peak_dominates_steady_state(self):
+        """A huge one-shot init container sets the pod's effective request
+        even when steady state is small (k8s effective-request rule)."""
+        from karpenter_tpu.api.serialize import pod_from_manifest
+        p = pod_from_manifest({
+            "metadata": {"name": "x"},
+            "spec": {
+                "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+                "initContainers": [
+                    {"resources": {"requests": {"cpu": "10"}}}]}})
+        assert p.requests["cpu"] == 10_000
+
     def test_parsed_pod_schedules(self):
         from helpers import small_catalog
         from karpenter_tpu.api.objects import NodePool
