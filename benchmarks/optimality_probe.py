@@ -110,12 +110,17 @@ def repair_trial(prob, plan, tau=0.7):
     ex_alloc = np.stack([alloc[oi] for oi, _, _ in survivors]) \
         if survivors else None
     ex_used = np.stack([u for _, _, u in survivors]) if survivors else None
-    # build a sub-problem over the victim pods only
+    # build a sub-problem over the victim pods only (identical pods are
+    # interchangeable within a class, so lpguide's tail-slicing builder
+    # gives the same cost accounting as the literal victim ids)
+    from karpenter_tpu.ops.lpguide import _subproblem
     sub_counts = {}
     for p in vic_pods:
         sub_counts[_class_of(prob, p)] = sub_counts.get(_class_of(prob, p), 0) + 1
-    cls = sorted(sub_counts)
-    sub = _subproblem(prob, cls, sub_counts)
+    cls = np.asarray(sorted(sub_counts))
+    sub = _subproblem(prob, cls,
+                      np.asarray([sub_counts[c] for c in cls], np.int64),
+                      np.zeros(prob.num_classes, np.int64))
     ex_compat = prob.class_compat[cls][:, [oi for oi, _, _ in survivors]] \
         if survivors else None
     # existing-node compat: victim-class pod may land on a survivor only if
@@ -132,26 +137,6 @@ def repair_trial(prob, plan, tau=0.7):
           f"total ${new_cost:.2f} (was ${plan.total_price:.2f}) "
           f"[{dt:.0f}ms]", flush=True)
     return new_cost
-
-
-def _subproblem(prob, cls, sub_counts):
-    """A Problem restricted to the given classes with the given counts."""
-    import copy
-    sub = copy.copy(prob)
-    sub.class_requests = prob.class_requests[cls]
-    sub.class_counts = np.array([sub_counts[c] for c in cls], np.int32)
-    sub.class_compat = prob.class_compat[cls]
-    if prob.class_node_cap is not None:
-        sub.class_node_cap = prob.class_node_cap[cls]
-    # fake member lists (indices don't matter for cost accounting)
-    off = 0
-    members = []
-    for c in cls:
-        members.append(np.arange(off, off + sub_counts[c], dtype=np.int64))
-        off += sub_counts[c]
-    sub.class_members = members
-    sub.__dict__.pop("_members_arr", None)
-    return sub
 
 
 def main():

@@ -366,16 +366,31 @@ def solve_classpack(problem: Problem,
                     existing_used: Optional[np.ndarray] = None,
                     existing_compat: Optional[np.ndarray] = None,
                     decode: bool = True,
-                    max_alternatives: int = 60) -> PackingResult:
+                    max_alternatives: int = 60,
+                    guide: Optional[str] = "lp") -> PackingResult:
     """Host wrapper: sort classes → pad → kernel → decode.
 
     With decode=False only aggregate state is materialized (bench path:
-    node count + total price, no per-pod binding)."""
+    node count + total price, no per-pod binding).
+
+    guide="lp" (the default for fresh solves) first solves the class-LP
+    on device (ops/lpguide.py) and pins each class's bulk to the LP's
+    option mix via split rows — closing the greedy's option-choice gap
+    (measured 9.5% → ~2% on the bench's mixed shapes) while the scan
+    kernel, audits, and decode stay the same code path.  Solves against
+    existing capacity (consolidation probes, E>0) skip the guide: their
+    cost question is "fits into what's already bought", not mix."""
     E = 0 if existing_alloc is None else len(existing_alloc)
     ec = None
     if E:
         ec = existing_compat if existing_compat is not None else \
             np.ones((problem.num_classes, E), bool)
+    if guide == "lp" and E == 0 and decode:
+        from .lpguide import solve_guided
+        res = solve_guided(problem, max_alternatives=max_alternatives,
+                           max_nodes=max_nodes)
+        if res is not None:
+            return res
     requests, counts, compat, caps, order = _sorted_classes(problem, ec)
     C, R = requests.shape
     alloc = problem.option_alloc
@@ -475,9 +490,6 @@ def solve_classpack(problem: Problem,
     assignment, slot_option, n_unsched = jax.device_get(out)
     assignment = np.asarray(assignment, dtype=np.int32)[:P]
 
-    new_mask = (slot_option >= 0) & (slot_option < O)
-    total = float(problem.option_price[slot_option[new_mask]].sum())
-
     # rows follow the sorted-class order, members consumed in sequence —
     # the same walk the takes-based decode did, now fully vectorized
     members_arr = problem.members_arrays()
@@ -526,27 +538,68 @@ def solve_classpack(problem: Problem,
     # order of magnitude cheaper than per-element numpy scalar access
     pod_sorted = pod_idx[new_rows].tolist()
     node_oi = slot_option[node_slots].astype(np.int64)
+    # fleet cost: only pod-hosting slots launch.  Demand-driven opens
+    # always host ≥1 pod so this matches the old every-open-slot sum; the
+    # difference is guided solves, whose pre-opened-but-unfilled slots
+    # must not be bought.
+    launch_mask = (node_oi >= 0) & (node_oi < O)
+    total = float(problem.option_price[node_oi[launch_mask]].sum())
     oi_l = node_oi.tolist()
     starts_l, ends_l = starts.tolist(), ends.tolist()
     options_l = problem.options
 
-    # per-node flexible alternatives (and the used ResourceList) dedupe
-    # hard: full nodes of the same class mix share (pool, joint-compat,
-    # used) exactly, so a 5k-node plan has only a few hundred distinct
-    # content keys.  Every node resolves through a cross-solve
-    # content-keyed memo; cold keys queue ONCE (dict dedup) for a single
-    # batched capacity/compat filter below.
-    N = len(oi_l)
     compat_bits = np.packbits(problem.class_compat, axis=1)
+    ucls_l = ucls.tolist()
+    cs_l, ce_l = cls_starts.tolist(), cls_ends.tolist()
+    N = len(oi_l)
+    jcb_list: List = [None] * N
+    for i in range(N):
+        if not (0 <= oi_l[i] < O):
+            continue
+        cls = ucls_l[cs_l[i]:ce_l[i]]
+        jcb_list[i] = (compat_bits[cls[0]] if len(cls) == 1 else
+                       np.bitwise_and.reduce(compat_bits[cls], axis=0))
+    resolved = resolve_alternatives(problem, oi_l, jcb_list, node_used,
+                                    max_alternatives)
+
+    nodes = []
+    for i in range(N):
+        hit = resolved[i]
+        if hit is None:
+            continue
+        nodes.append(NodeDecision(
+            option=options_l[oi_l[i]],
+            pod_indices=pod_sorted[starts_l[i]:ends_l[i]],
+            used=hit[1],
+            alternatives=hit[0],
+        ))
+    return PackingResult(nodes=nodes, unschedulable=unschedulable,
+                         existing_assignments=existing_assignments,
+                         total_price=total)
+
+
+def resolve_alternatives(problem: Problem, oi_l: Sequence[int],
+                         jcb_list: Sequence, node_used: np.ndarray,
+                         max_alternatives: int = 60) -> List:
+    """Per-node flexible alternatives (and the used ResourceList).
+
+    These dedupe hard: full nodes of the same class mix share (pool,
+    joint-compat, used) exactly, so a 5k-node plan has only a few hundred
+    distinct content keys.  Every node resolves through a cross-solve
+    content-keyed memo; cold keys queue ONCE (dict dedup) for a single
+    batched capacity/compat filter.  Inputs: per-node option index,
+    per-node joint compat bits (AND over hosted classes, packbits form;
+    None to skip), per-node used vectors (N×R).  Returns a list aligned
+    with the inputs of (alternatives, used_ResourceList) or None."""
+    options_l = problem.options
+    O = problem.num_options
     option_alloc = problem.option_alloc
     # per-resource rows contiguous for the global capacity compare
     allocT = np.ascontiguousarray(option_alloc.T)
     pool_of_option = np.asarray([o.pool for o in options_l])
     pool_masks: Dict[object, np.ndarray] = {}
     memo = _alt_memo_for(problem)
-
-    ucls_l = ucls.tolist()
-    cs_l, ce_l = cls_starts.tolist(), cls_ends.tolist()
+    N = len(oi_l)
     used_l = node_used.tolist()
     node_ckeys: List = [None] * N
     # thread-local view of every resolved key: the shared memo can be
@@ -558,13 +611,9 @@ def solve_classpack(problem: Problem,
     miss_jc: List[np.ndarray] = []
     for i in range(N):
         oi = oi_l[i]
-        if not (0 <= oi < O):
+        if not (0 <= oi < O) or jcb_list[i] is None:
             continue
-        cls = ucls_l[cs_l[i]:ce_l[i]]
-        if len(cls) == 1:
-            jcb = compat_bits[cls[0]]
-        else:
-            jcb = np.bitwise_and.reduce(compat_bits[cls], axis=0)
+        jcb = jcb_list[i]
         pool = options_l[oi].pool
         ckey = (pool, jcb.tobytes(), tuple(used_l[i]), max_alternatives)
         node_ckeys[i] = ckey
@@ -581,7 +630,7 @@ def solve_classpack(problem: Problem,
         # ONE global capacity filter for every distinct miss: per-resource
         # outer compare with a running AND (M×O per resource) — no
         # per-group fancy-indexed copies of the catalog, no M×O×R temporary
-        used_mat = node_used[miss_nodes].astype(option_alloc.dtype)
+        used_mat = np.asarray(node_used)[miss_nodes].astype(option_alloc.dtype)
         M = len(miss_nodes)
         ok = np.ones((M, option_alloc.shape[0]), bool)
         for r in range(allocT.shape[0]):
@@ -603,18 +652,4 @@ def solve_classpack(problem: Problem,
             resolved[ckey] = val
             memo[ckey] = val
 
-    nodes = []
-    for i in range(N):
-        ckey = node_ckeys[i]
-        if ckey is None:
-            continue
-        hit = resolved[ckey]
-        nodes.append(NodeDecision(
-            option=options_l[oi_l[i]],
-            pod_indices=pod_sorted[starts_l[i]:ends_l[i]],
-            used=hit[1],
-            alternatives=hit[0],
-        ))
-    return PackingResult(nodes=nodes, unschedulable=unschedulable,
-                         existing_assignments=existing_assignments,
-                         total_price=total)
+    return [resolved[k] if k is not None else None for k in node_ckeys]
