@@ -111,10 +111,18 @@ def run_config(name, pods, n_types, pools=None, iters=5):
     return e2e_p50, solve_p50
 
 
-def run_consolidation_replay(n_nodes=500, n_types=200, iters=3):
-    """BASELINE config 4: 500-node consolidation replay — one batched
-    candidate evaluation over a live cluster (the reference replays the
-    scheduler once per candidate; here all candidates are one simulate)."""
+def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
+                             iters=3):
+    """BASELINE config 4: 500 under-utilized nodes → multi-node replace
+    simulation.  Built the way the reference's deprovisioning suite does
+    (/root/reference/test/suites/scale/deprovisioning_test.go:325-428):
+    provision a dense fleet, scale the workload down to ~28% utilization,
+    then evaluate consolidation.  The timed call is ONE batched simulate
+    over the FULL candidate set (the reference replays the scheduler per
+    candidate; r4's bench quietly timed a single candidate — fixed), plus
+    the decode=False feasibility-probe variant the controller's binary
+    search actually runs.  The decode=True call is the accepted-action
+    decode latency: it returns real per-pod assignments."""
     import numpy as np
     from karpenter_tpu.api.objects import NodePool, Pod
     from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
@@ -130,32 +138,49 @@ def run_consolidation_replay(n_nodes=500, n_types=200, iters=3):
     cluster = Cluster()
     pools = [NodePool()]
     prov = Provisioner(provider, cluster, pools)
-    # ~60% utilization so plenty of consolidation candidates exist
-    cluster.add_pods([Pod(requests=ResourceList(
-        {CPU: int(rng.integers(1500, 2600)), MEMORY: int(rng.integers(2, 5)) * 2**30}))
-        for _ in range(n_nodes)])
+    pods = [Pod(requests=ResourceList(
+        {CPU: int(rng.integers(1500, 2600)),
+         MEMORY: int(rng.integers(2, 5)) * 2**30}))
+        for _ in range(n_pods)]
+    cluster.add_pods(pods)
     prov.provision()
+    for p in pods:
+        if rng.random() < scale_down:
+            cluster.delete_pod(p)
     ctrl = DisruptionController(provider, cluster, pools,
                                 clock=lambda: time.time() + 10_000)
     cands = ctrl.candidates()
-    cap = cands[0].price if cands else None
+    cap = sum(c.price for c in cands) if cands else None
     times, probe_times = [], []
     for _ in range(iters):
         t0 = time.perf_counter()
-        ctrl.simulate(cands[:1], allow_new=True, max_total_price=cap)
+        ctrl.simulate(cands, allow_new=True, max_total_price=cap)
         times.append((time.perf_counter() - t0) * 1000)
-        # the feasibility-probe path the controller's binary search and
-        # single-node screens actually run (decode=False aggregate kernel)
         t0 = time.perf_counter()
-        ctrl.simulate(cands[:1], allow_new=True, max_total_price=cap,
+        ctrl.simulate(cands, allow_new=True, max_total_price=cap,
                       decode=False)
         probe_times.append((time.perf_counter() - t0) * 1000)
     p50 = float(np.median(times))
     probe_p50 = float(np.median(probe_times))
     log(f"[consolidation-replay] nodes={len(cluster.nodes)} "
-        f"candidates={len(cands)} simulate_p50={p50:.1f}ms "
+        f"candidates={len(cands)} batched_simulate_p50={p50:.1f}ms "
         f"probe_p50={probe_p50:.1f}ms")
     return p50
+
+
+def run_interruption_benchmark(sizes=(100, 1000, 5000, 15000)):
+    """The reference's `make benchmark`
+    (/root/reference/pkg/controllers/interruption/interruption_benchmark_test.go:62-79)
+    as a bench stage: drain N preloaded spot-interruption messages over a
+    live fleet, one stderr line per size (r4 verdict #7: the benchmark
+    existed but no round artifact ever recorded its numbers)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "benchmarks"))
+    from interruption_benchmark import run_size
+    for n in sizes:
+        r = run_size(n)
+        log(f"[interruption-{n}] {r['msgs_per_second']}/s "
+            f"({r['seconds']}s, fleet={r['recycled_nodes']})")
 
 
 def _probe_backend(timeout=120.0):
@@ -223,6 +248,8 @@ def run_all():
     run_config("5k-gpu", build_pods(40, 5_000, rng, gpu_frac=1.0), 600, iters=3)
     # config 4: 500-node consolidation replay
     run_consolidation_replay()
+    # interruption-controller throughput (the reference's `make benchmark`)
+    run_interruption_benchmark()
     # config 5 (headline): 50k burst, 600 types, constraints + spot/od pricing
     # (9 timed iterations: machine-load outliers on shared hosts/tunnels are
     # 1-2 per burst, so a wider sample keeps the p50 on the true latency)

@@ -82,15 +82,17 @@ _class_cache = {}
 
 
 def _class_of(prob, p):
+    # keyed by id but holding a strong ref and identity-checked, so a
+    # freed Problem's recycled address can never serve a stale map
     key = id(prob)
-    m = _class_cache.get(key)
-    if m is None:
+    hit = _class_cache.get(key)
+    if hit is None or hit[0] is not prob:
         m = {}
         for ci, mem in enumerate(prob.class_members):
             for q in np.asarray(mem):
                 m[int(q)] = ci
-        _class_cache[key] = m
-    return m[p]
+        _class_cache[key] = hit = (prob, m)
+    return hit[1][p]
 
 
 def repair_trial(prob, plan, tau=0.7):

@@ -128,7 +128,10 @@ class DisruptionController:
                  clock: Callable[[], float] = time.time,
                  stabilization_s: float = DEFAULT_STABILIZATION_S,
                  drift_enabled: bool = True,
-                 max_candidates: int = 64,
+                 # the reference's multi-node consolidation considers at
+                 # most 100 candidates per pass (karpenter-core
+                 # MultiNodeConsolidation.firstNConsolidationOption)
+                 max_candidates: int = 100,
                  terminator: Optional["TerminationController"] = None,
                  spot_min_flexibility: int = SPOT_TO_SPOT_MIN_ALTERNATIVES,
                  recorder=None):

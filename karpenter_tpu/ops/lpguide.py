@@ -286,10 +286,14 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     caps = (problem.class_node_cap if problem.class_node_cap is not None
             else np.full(C0, _BIG, np.int32))
 
+    # max_nodes is part of the key: a gate rejection under a tight launch
+    # cap must not disable the guide for the same pending set solved with
+    # a roomier budget (review r5)
     key = hashlib.blake2b(
         problem.class_requests.tobytes() + problem.class_counts.tobytes()
         + np.packbits(ok).tobytes() + caps.tobytes()
-        + problem.option_alloc.tobytes() + problem.option_price.tobytes(),
+        + problem.option_alloc.tobytes() + problem.option_price.tobytes()
+        + str(max_nodes).encode(),
         digest_size=16).digest()
     hit = _MIX_CACHE.get(key)
     if hit is None:
@@ -441,9 +445,25 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
                 rem_cls, [bulk_oi[i] for i in roomy])]
         # remainder opens count against the same per-round budget the
         # striped fleet already consumed (existing columns occupy K slots
-        # too, so they ride on top of the remaining allowance)
-        sub_max = max(1, max_nodes - len(bulk_oi)) + len(ex_map)
-        sub_res = solve_classpack(sub, max_nodes=sub_max,
+        # too, so they ride on top of the remaining allowance).  A fully
+        # consumed budget removes the catalog outright — remainder pods
+        # may still tuck into striped free space, but nothing launches
+        # (review r5: the old max(1, …) floor leaked one extra node).
+        budget = max_nodes - len(bulk_oi)
+        if budget <= 0:
+            sub.options = []
+            sub.option_alloc = sub.option_alloc[:0]
+            sub.option_price = sub.option_price[:0]
+            if sub.option_rank is not None:
+                sub.option_rank = sub.option_rank[:0]
+            if sub.option_zone is not None:
+                sub.option_zone = sub.option_zone[:0]
+            if sub.option_captype is not None:
+                sub.option_captype = sub.option_captype[:0]
+            sub.class_compat = sub.class_compat[:, :0]
+            budget = 0
+        sub_max = budget + len(ex_map)
+        sub_res = solve_classpack(sub, max_nodes=max(sub_max, 1),
                                   existing_alloc=ex_alloc,
                                   existing_used=ex_used,
                                   existing_compat=ex_compat,
@@ -487,9 +507,8 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     capped_frac = float(problem.class_counts[caps < _BIG].sum()) / \
         max(float(problem.class_counts.sum()), 1.0)
     if z_lp > 0 and capped_frac < 0.5 and probe_total > 1.08 * z_lp:
-        from .classpack import solve_classpack as _solve
-        greedy = _solve(problem, max_nodes=max_nodes, decode=False,
-                        guide=None)
+        greedy = solve_classpack(problem, max_nodes=max_nodes, decode=False,
+                                 guide=None)
         # strictly worse only: a tie keeps the guided plan (its decode is
         # already materialized) instead of permanently rejecting the key
         if (probe_unsched, probe_total) > (len(greedy.unschedulable),

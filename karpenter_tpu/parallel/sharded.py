@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.classpack import class_pack_aggregate_kernel
+from ..ops.classpack import (class_pack_aggregate_kernel,
+                             class_pack_assign_kernel)
 from ..ops.tensorize import Problem, pad_to
 
 SHARD_AXIS = "pods"
@@ -134,26 +135,71 @@ def _sharded_pack(requests, counts_sharded, compat, node_cap, alloc, price,
     return flat[0], flat[3:3 + O].astype(jnp.int32), flat[2].astype(jnp.int32)
 
 
-def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
-                  max_nodes_per_shard: int = 4096):
-    """Pack a Problem over a device mesh — 1-D (pods) or hybrid 2-D
-    (hosts × chips).  Returns
-    (total_cost, nodes_per_option O int array, unscheduled count)."""
-    mesh = mesh or make_pod_mesh()
+@partial(jax.jit, static_argnames=("max_nodes_per_shard", "n_pods_shard",
+                                   "mesh"))
+def _sharded_assign(requests, counts_sharded, compat_packed_sharded,
+                    node_cap, alloc, price, rank,
+                    init_option_sharded, init_used_sharded,
+                    max_nodes_per_shard: int, n_pods_shard: int, mesh: Mesh):
+    """shard_map'd DECODE pack: every device runs the full assign kernel
+    on its pod slice and returns per-pod slot ids.  Slots are per-shard
+    local (each shard's bins are disjoint by construction — a bin never
+    spans pods from two shards), so the host decode offsets them by
+    shard_index × K to get globally unique node ids.  Per-shard inputs
+    (counts, compat column mask, pre-opened existing slots) arrive as
+    leading-mesh-axis arrays; the catalog side stays replicated."""
+    axes = tuple(mesh.axis_names)
+    unit_dims = len(axes)
+
+    def shard_fn(counts_l, compat_l, init_opt_l, init_used_l):
+        for _ in range(unit_dims):
+            counts_l = counts_l[0]
+            compat_l = compat_l[0]
+            init_opt_l = init_opt_l[0]
+            init_used_l = init_used_l[0]
+        assignment, slot_option, n_unsched = class_pack_assign_kernel(
+            requests, counts_l, compat_l, node_cap, alloc, price, rank,
+            init_opt_l, init_used_l, max_nodes_per_shard, n_pods_shard)
+        idx = (None,) * unit_dims
+        return assignment[idx], slot_option[idx], n_unsched[idx]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(*axes), P(*axes), P(*axes), P(*axes)),
+        out_specs=(P(*axes), P(*axes), P(*axes)))
+    return fn(counts_sharded, compat_packed_sharded,
+              init_option_sharded, init_used_sharded)
+
+
+def _lower(problem: Problem, mesh: Mesh,
+           existing_alloc=None, existing_compat=None):
+    """Shared lowering: FFD-sorted padded arrays + per-shard count split.
+    Existing-node columns are appended after the real options with
+    price=+inf (never launchable, only fillable) and OWNED by exactly one
+    shard via a per-shard column mask — bins stay disjoint across the
+    mesh, which is what makes pod-batch sharding a valid bin-packing
+    decomposition."""
     n = mesh.devices.size
     order = problem.class_order()
     C = problem.num_classes
     Cpad = pad_to(C, (64, 256, 1024, 4096))
     R = len(problem.axes)
     O = problem.num_options
-    Opad = pad_to(O, (512, 2048, 4096, 8192))
+    E = 0 if existing_alloc is None else len(existing_alloc)
+    Opad = pad_to(O + E, (512, 2048, 4096, 8192))
 
     requests = np.zeros((Cpad, R), np.int32)
     requests[:C] = problem.class_requests[order].astype(np.int32)
     compat = np.zeros((Cpad, Opad), bool)
     compat[:C, :O] = problem.class_compat[order]
+    if E:
+        ec = existing_compat if existing_compat is not None else \
+            np.ones((problem.num_classes, E), bool)
+        compat[:C, O:O + E] = ec[order]
     alloc = np.zeros((Opad, R), np.int32)
     alloc[:O] = problem.option_alloc.astype(np.int32)
+    if E:
+        alloc[O:O + E] = np.ceil(existing_alloc).astype(np.int32)
     price = np.full(Opad, np.inf, np.float32)
     price[:O] = problem.option_price
     rank = np.full(Opad, 2**30 - 1, np.int32)
@@ -165,14 +211,178 @@ def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
     counts_sharded = np.zeros((n, Cpad), np.int32)
     counts_sharded[:, :C] = split_counts(
         problem.class_counts[order].astype(np.int32), n)
-    # a hybrid mesh shards the same flat split over (hosts, chips)
-    counts_sharded = counts_sharded.reshape(*mesh.devices.shape, Cpad)
+    return (order, C, Cpad, R, O, E, Opad, requests, compat, alloc, price,
+            rank, node_cap, counts_sharded)
 
-    cost, nodes_per_option, unsched = _sharded_pack(
-        jnp.asarray(requests), jnp.asarray(counts_sharded), jnp.asarray(compat),
-        jnp.asarray(node_cap),
-        jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank),
-        max_nodes_per_shard, mesh)
-    cost, nodes_per_option, unsched = jax.device_get(
-        (cost, nodes_per_option, unsched))
-    return float(cost), np.asarray(nodes_per_option)[:O], int(unsched)
+
+def solve_sharded(problem: Problem, mesh: Optional[Mesh] = None,
+                  max_nodes_per_shard: int = 4096,
+                  decode: bool = False,
+                  existing_alloc: Optional[np.ndarray] = None,
+                  existing_used: Optional[np.ndarray] = None,
+                  existing_compat: Optional[np.ndarray] = None):
+    """Pack a Problem over a device mesh — 1-D (pods) or hybrid 2-D
+    (hosts × chips).
+
+    decode=False returns (total_cost, nodes_per_option, unsched_count)
+    via one hierarchical psum — the feasibility-probe contract.
+
+    decode=True returns a PackingResult with real per-pod assignments:
+    each shard runs the assign kernel on its slice, slot ids are
+    globalized by shard offset, and the host decode (node runs,
+    alternatives memo, pod-hosting-only cost) matches the single-chip
+    path audit for audit.  Existing-node columns ride the mesh too: each
+    existing node is owned by one shard (round-robin) and masked out of
+    every other shard's compat, so consolidation probes and
+    schedule-on-existing solves can use multi-chip solves."""
+    mesh = mesh or make_pod_mesh()
+    n = mesh.devices.size
+    (order, C, Cpad, R, O, E, Opad, requests, compat, alloc, price, rank,
+     node_cap, counts_flat) = _lower(problem, mesh, existing_alloc,
+                                     existing_compat)
+    K = max_nodes_per_shard
+
+    if not decode:
+        assert E == 0, "existing columns require decode=True (the "\
+            "aggregate reduction cannot attribute fills to owners)"
+        counts_sharded = counts_flat.reshape(*mesh.devices.shape, Cpad)
+        cost, nodes_per_option, unsched = _sharded_pack(
+            jnp.asarray(requests), jnp.asarray(counts_sharded),
+            jnp.asarray(compat), jnp.asarray(node_cap), jnp.asarray(alloc),
+            jnp.asarray(price), jnp.asarray(rank), K, mesh)
+        cost, nodes_per_option, unsched = jax.device_get(
+            (cost, nodes_per_option, unsched))
+        return float(cost), np.asarray(nodes_per_option)[:O], int(unsched)
+
+    # ---- per-shard inputs for the decode path ----
+    own = [np.nonzero(np.arange(E) % n == s)[0] for s in range(n)]
+    E_max = max((len(o) for o in own), default=0)
+    assert K > E_max, "max_nodes_per_shard must exceed owned existing nodes"
+    compat_sh = np.zeros((n, Cpad, Opad), bool)
+    init_opt = np.full((n, K), -1, np.int32)
+    init_used = np.zeros((n, K, R), np.int32)
+    for s in range(n):
+        cm = compat.copy()
+        if E:
+            mask = np.zeros(E, bool)
+            mask[own[s]] = True
+            cm[:, O:O + E] &= mask[None, :]
+            init_opt[s, :len(own[s])] = O + own[s]
+            if existing_used is not None:
+                init_used[s, :len(own[s])] = np.ceil(
+                    existing_used[own[s]]).astype(np.int32)
+        compat_sh[s] = cm
+    compat_packed = np.packbits(compat_sh, axis=2)
+
+    P_shard = int(counts_flat.sum(axis=1).max()) if n else 0
+    Ppad = pad_to(max(P_shard, 1))
+    shape = mesh.devices.shape
+    out = _sharded_assign(
+        jnp.asarray(requests),
+        jnp.asarray(counts_flat.reshape(*shape, Cpad)),
+        jnp.asarray(compat_packed.reshape(*shape, *compat_packed.shape[1:])),
+        jnp.asarray(node_cap), jnp.asarray(alloc), jnp.asarray(price),
+        jnp.asarray(rank),
+        jnp.asarray(init_opt.reshape(*shape, K)),
+        jnp.asarray(init_used.reshape(*shape, K, R)),
+        K, Ppad, mesh)
+    assignment, slot_option, _unsched = jax.device_get(out)
+    assignment = np.asarray(assignment).reshape(n, Ppad).astype(np.int32)
+    slot_option = np.asarray(slot_option).reshape(n, K)
+    return _decode_sharded(problem, order, counts_flat, assignment,
+                           slot_option, own, O, E, K, n)
+
+
+def _decode_sharded(problem, order, counts_flat, assignment, slot_option,
+                    own, O, E, K, n):
+    """Host decode over all shards at once: pod ids per shard from the
+    split member chunks, node runs from globally-offset slot ids, then
+    the same alternatives/usage assembly as the single-chip path."""
+    from ..ops.classpack import resolve_alternatives
+    from ..ops.ffd import NodeDecision, PackingResult
+
+    members_arr = problem.members_arrays()
+    C = problem.num_classes
+    # member consumption: class c's members split shard-major in the same
+    # order split_counts dealt them
+    csum = np.zeros(C, np.int64)
+    pod_parts, cls_parts, slot_parts = [], [], []
+    for s in range(n):
+        cnt_s = counts_flat[s]
+        P_s = int(cnt_s.sum())
+        if P_s == 0:
+            continue
+        chunks = []
+        cls_ids = []
+        # counts_flat rows follow the FFD order already
+        for pos, ci in enumerate(order):
+            k = int(cnt_s[pos])
+            if k == 0:
+                continue
+            mem = members_arr[ci]
+            chunks.append(mem[csum[ci]:csum[ci] + k])
+            cls_ids.append(np.full(k, ci, np.int64))
+            csum[ci] += k
+        pod_s = np.concatenate(chunks)
+        a_s = assignment[s, :P_s]
+        sched = a_s >= 0
+        # globalize: local slot → shard-offset slot id
+        slot_parts.append(np.where(sched, a_s.astype(np.int64) + s * K, -1))
+        pod_parts.append(pod_s)
+        cls_parts.append(np.concatenate(cls_ids))
+    if not pod_parts:
+        return PackingResult(nodes=[], unschedulable=[],
+                             existing_assignments={}, total_price=0.0)
+    pod_all = np.concatenate(pod_parts)
+    cls_all = np.concatenate(cls_parts)
+    slot_all = np.concatenate(slot_parts)
+
+    unschedulable = pod_all[slot_all < 0].tolist()
+    sched = slot_all >= 0
+    pod_all, cls_all, slot_all = pod_all[sched], cls_all[sched], slot_all[sched]
+    o = np.argsort(slot_all, kind="stable")
+    pod_all, cls_all, slot_all = pod_all[o], cls_all[o], slot_all[o]
+    starts = np.nonzero(np.diff(slot_all, prepend=np.int64(-1)))[0]
+    ends = np.append(starts[1:], len(slot_all))
+    node_slots = slot_all[starts]
+    node_shard = (node_slots // K).astype(np.int64)
+    node_local = (node_slots % K).astype(np.int64)
+    node_col = slot_option[node_shard, node_local].astype(np.int64)
+
+    # existing vs new: columns ≥ O are existing-node fills
+    existing_assignments = {}
+    nodes = []
+    new_idx = []
+    jcb_list = []
+    used_rows = []
+    compat_bits = np.packbits(problem.class_compat, axis=1)
+    reqs = problem.class_requests.astype(np.int64)
+    pods_l = pod_all.tolist()
+    for i in range(len(node_slots)):
+        s, e = starts[i], ends[i]
+        col = node_col[i]
+        if col >= O:
+            eid = int(col - O)
+            for p in pods_l[s:e]:
+                existing_assignments[p] = eid
+            continue
+        cl = np.unique(cls_all[s:e])
+        jcb_list.append(compat_bits[cl[0]] if len(cl) == 1 else
+                        np.bitwise_and.reduce(compat_bits[cl], axis=0))
+        used_rows.append(reqs[cls_all[s:e]].sum(axis=0))
+        new_idx.append(i)
+    oi_l = [int(node_col[i]) for i in new_idx]
+    used_mat = (np.asarray(used_rows, np.int64) if used_rows else
+                np.zeros((0, reqs.shape[1]), np.int64))
+    resolved = resolve_alternatives(problem, oi_l, jcb_list, used_mat)
+    total = 0.0
+    for j, i in enumerate(new_idx):
+        alts, used_rl = resolved[j]
+        nodes.append(NodeDecision(
+            option=problem.options[oi_l[j]],
+            pod_indices=pods_l[starts[i]:ends[i]],
+            used=used_rl, alternatives=alts))
+        total += float(problem.option_price[oi_l[j]])
+    return PackingResult(nodes=nodes, unschedulable=unschedulable,
+                         existing_assignments=existing_assignments,
+                         total_price=total)

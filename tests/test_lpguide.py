@@ -155,6 +155,21 @@ class TestSolveGuided:
         assert len(r.nodes) <= 4
         assert len(r.unschedulable) > 0    # the rest waits for next round
 
+    def test_max_nodes_exactly_consumed_by_bulk(self):
+        """When the striped fleet consumes the whole budget, the
+        remainder may tuck into striped free space but must NOT launch
+        (review r5: the old max(1, …) floor leaked one extra node)."""
+        prob = tensorize(_blend_pods(200), _catalog_2ratio(), [NodePool()])
+        r_free = solve_classpack(prob)
+        if r_free is None or not r_free.nodes:
+            return
+        cap = len(r_free.nodes)
+        for budget in (cap, cap - 1):
+            r = solve_classpack(prob, max_nodes=budget)
+            assert len(r.nodes) <= budget, (budget, len(r.nodes))
+            placed = sum(len(nd.pod_indices) for nd in r.nodes)
+            assert placed + len(r.unschedulable) == 200
+
     def test_guide_skipped_for_existing_capacity(self):
         """Consolidation probes (E>0) must take the greedy path — the
         guide's mix question does not apply to already-bought nodes."""

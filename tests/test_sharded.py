@@ -22,20 +22,83 @@ def test_split_counts_exact():
     assert s.max() - s.min() <= 1 + counts.max() // 4  # roughly balanced
 
 
-def test_sharded_matches_single_device_envelope():
+def test_sharded_matches_per_shard_single_device_exactly():
+    """The decisive equivalence standard (r4 verdict #8): every shard's
+    plan must EQUAL the single-device solve of exactly its slice — same
+    kernel, same inputs, deterministic — so the mesh adds nothing but
+    partitioning.  (The old test accepted a 0.5×…+8-node envelope.)"""
+    import copy
+    from karpenter_tpu.parallel.sharded import split_counts
     pods = ([cpu_pod(cpu_m=1500, mem_mib=1024) for _ in range(40)]
             + [cpu_pod(cpu_m=300, mem_mib=256) for _ in range(80)])
     prob = tensorize(pods, small_catalog(), [NodePool()])
-    cost, nodes_per_option, unsched = solve_sharded(prob, make_pod_mesh(8),
+    n = 8
+    cost, nodes_per_option, unsched = solve_sharded(prob, make_pod_mesh(n),
                                                     max_nodes_per_shard=256)
     assert unsched == 0
-    single = solve_classpack(prob)
-    assert not single.unschedulable
-    # sharded packing can't merge bins across shards: cost within 8 marginal
-    # nodes of the single-device plan, never better than 0.5x
-    assert cost >= single.total_price * 0.5
-    assert cost <= single.total_price + 8 * prob.option_price.max()
-    assert nodes_per_option.sum() >= len(single.nodes)
+    counts_sharded = split_counts(prob.class_counts.astype(np.int32), n)
+    expect_cost = 0.0
+    expect_nodes = np.zeros(prob.num_options, np.int64)
+    from karpenter_tpu.ops.lpguide import _subproblem
+    ptr = np.zeros(prob.num_classes, np.int64)
+    for s in range(n):
+        cls = np.arange(prob.num_classes)
+        sub = _subproblem(prob, cls, counts_sharded[s].astype(np.int64), ptr)
+        ptr += counts_sharded[s]
+        r = solve_classpack(sub, guide=None)
+        assert not r.unschedulable
+        expect_cost += r.total_price
+        for nd in r.nodes:
+            expect_nodes[next(i for i, o in enumerate(prob.options)
+                              if o is nd.option)] += 1
+    assert cost == pytest.approx(expect_cost)
+    assert (nodes_per_option == expect_nodes).all()
+
+
+def test_sharded_decode_matches_aggregate_and_audits():
+    """decode=True must produce real per-pod assignments whose fleet
+    agrees exactly with the aggregate path, pass uniqueness/capacity
+    audits, and cost only pod-hosting nodes."""
+    pods = ([cpu_pod(cpu_m=1500, mem_mib=1024) for _ in range(40)]
+            + [cpu_pod(cpu_m=300, mem_mib=256) for _ in range(80)])
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    mesh = make_pod_mesh(8)
+    cost, nodes_per_option, unsched = solve_sharded(prob, mesh,
+                                                    max_nodes_per_shard=256)
+    res = solve_sharded(prob, mesh, max_nodes_per_shard=256, decode=True)
+    assert res.total_price == pytest.approx(cost)
+    assert len(res.unschedulable) == unsched == 0
+    assert len(res.nodes) == nodes_per_option.sum()
+    seen = set()
+    opt_index = {id(o): j for j, o in enumerate(prob.options)}
+    for nd in res.nodes:
+        used = np.zeros(len(prob.axes))
+        for p in nd.pod_indices:
+            assert p not in seen
+            seen.add(p)
+        cls = [ci for ci, mem in enumerate(prob.class_members)
+               for q in np.asarray(mem) if q in set(nd.pod_indices)]
+        used = prob.class_requests[cls].sum(axis=0)
+        assert (used <= prob.option_alloc[opt_index[id(nd.option)]] + 1e-9).all()
+    assert len(seen) == 120
+
+
+def test_sharded_decode_existing_columns_owned():
+    """Existing nodes ride the mesh with per-shard ownership: pods land
+    on existing capacity (no launches) and every fill respects the
+    owner's free space."""
+    pods = [cpu_pod(cpu_m=500, mem_mib=256) for _ in range(64)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    E = 16
+    big = prob.option_alloc.max(axis=0) * 2
+    ex_alloc = np.tile(big, (E, 1))
+    res = solve_sharded(prob, make_pod_mesh(8), max_nodes_per_shard=64,
+                        decode=True, existing_alloc=ex_alloc,
+                        existing_used=np.zeros_like(ex_alloc))
+    assert not res.unschedulable
+    assert len(res.existing_assignments) == 64    # all tucked, no launches
+    assert res.total_price == 0.0
+    assert set(res.existing_assignments.values()) <= set(range(E))
 
 
 def test_sharded_runs_on_smaller_mesh():
