@@ -61,6 +61,10 @@ class Pod:
     name: str = ""
     namespace: str = "default"
     requests: ResourceList = field(default_factory=ResourceList)
+    # container limits, summed like requests (empty == none declared);
+    # feeds the karpenter_nodes_total_pod_limits/_daemon_limits gauges —
+    # the solver packs on requests, as the kube-scheduler does
+    limits: ResourceList = field(default_factory=ResourceList)
     node_selector: Dict[str, str] = field(default_factory=dict)
     # Required node-affinity: list of OR'd terms, each term a Requirements AND-set.
     required_affinity_terms: List[Requirements] = field(default_factory=list)

@@ -653,6 +653,14 @@ def pod_from_manifest(m: Dict) -> "Pod":
         [_requests(c) for c in spec.get("containers", [])],
         [(_requests(c), c.get("restartPolicy") == "Always")
          for c in spec.get("initContainers", [])])
+    # declared limits aggregate under the same effective-request formula;
+    # containers without limits contribute nothing (k8s: unlimited)
+    lim = pod_requests(
+        [ResourceList.parse((c.get("resources", {}) or {}).get("limits")
+                            or {}) for c in spec.get("containers", [])],
+        [(ResourceList.parse((c.get("resources", {}) or {}).get("limits")
+                             or {}), c.get("restartPolicy") == "Always")
+         for c in spec.get("initContainers", [])])
 
     required_terms: List[Requirements] = []
     preferred_terms: List = []
@@ -714,6 +722,7 @@ def pod_from_manifest(m: Dict) -> "Pod":
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
         requests=req,
+        limits=lim,
         node_selector=dict(spec.get("nodeSelector", {}) or {}),
         required_affinity_terms=required_terms,
         preferred_affinity_terms=preferred_terms,

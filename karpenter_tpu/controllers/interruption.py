@@ -119,7 +119,14 @@ class InterruptionController:
             if event.kind == STATE_CHANGE and \
                     event.detail.get("state", "") not in _DEAD_STATES:
                 continue
-            ok = self._recycle(node, claim, event.kind, out) and ok
+            done = self._recycle(node, claim, event.kind, out)
+            ok = done and ok
+            if done:
+                # count COMPLETED actions only: a PDB-blocked drain leaves
+                # the message for redelivery, and counting each retry would
+                # inflate one interruption into thousands of "actions"
+                metrics.interruption_actions().inc(
+                    {"action": f"CordonAndDrain/{event.kind}"})
         return ok
 
     def _mark_spot_unavailable(self, node: Optional[Node],

@@ -136,11 +136,14 @@ class Provisioner:
             for res, qty in usage.get(pool_name, ResourceList()).items():
                 usage_g.set(qty, {"nodepool": pool_name, "resource_type": res})
                 cur_u.add((pool_name, res))
+        pct_g = metrics.nodepool_usage_pct()
         out = []
         for pool in self.nodepools.values():
             pool_usage = usage.get(pool.name, ResourceList())
             for res, qty in (pool.limits or {}).items():
                 limit_g.set(qty, {"nodepool": pool.name, "resource_type": res})
+                pct_g.set(100.0 * pool_usage.get(res, 0) / qty if qty else 0.0,
+                          {"nodepool": pool.name, "resource_type": res})
                 cur_l.add((pool.name, res))
             if pool.within_limits(pool_usage):
                 out.append(pool)
@@ -150,19 +153,33 @@ class Provisioner:
             usage_g.delete({"nodepool": pool_name, "resource_type": res})
         for pool_name, res in prev_l - cur_l:
             limit_g.delete({"nodepool": pool_name, "resource_type": res})
+            pct_g.delete({"nodepool": pool_name, "resource_type": res})
         self._usage_gauge_keys = cur_u
         self._limit_gauge_keys = cur_l
         return out
 
     def solve(self, pods: Sequence[Pod],
-              schedule_on_existing: bool = True) -> tuple:
+              schedule_on_existing: bool = True,
+              nodes: Optional[Sequence] = None,
+              pools: Optional[List[NodePool]] = None) -> tuple:
         """Tensorize + pack one batch, relaxing soft constraints level by
         level (preferred affinity, ScheduleAnyway spreads) while pods come
         back unschedulable — the batched analog of karpenter-core's
         preference-relaxation loop (see ops/constraints.py).
-        Returns (problem, PackingResult)."""
-        pools = self._pools_within_limits()  # weight precedence is encoded in
-        catalog = self.provider.get_instance_types()  # LaunchOption.weight_rank
+        Returns (problem, PackingResult).
+
+        `nodes`/`pools` override the live cluster's node set and the
+        limit-filtered pool list — a caller holding a point-in-time
+        snapshot (`Cluster.snapshot_nodes` + `_pools_within_limits` under
+        the state lock) can solve without the lock while the tick loop
+        keeps mutating real state (`_pools_within_limits` itself iterates
+        live nodes and updates gauge bookkeeping, so it must never run
+        off-lock)."""
+        if pools is None:
+            pools = self._pools_within_limits()  # weight precedence is encoded
+        catalog = self.provider.get_instance_types()  # in LaunchOption.weight_rank
+        node_view = (list(self.cluster.nodes.values()) if nodes is None
+                     else list(nodes))
         zone_rank: Dict[str, float] = {}
         for it in catalog:
             for o in it.offerings:
@@ -172,22 +189,21 @@ class Provisioner:
         # existing-node zones count as spread/affinity domains even when no
         # offering is currently available there (e.g. ICE-blacklisted): a
         # constrained pod can still bind to live capacity in that zone
-        zones = sorted(set(zone_rank) | {n.zone for n in self.cluster.nodes.values()
-                                         if n.zone})
+        zones = sorted(set(zone_rank) | {n.zone for n in node_view if n.zone})
         soft = has_soft_constraints(pods)
-        zone_feasible = make_zone_feasibility(catalog,
-                                              self.cluster.nodes.values())
+        zone_feasible = make_zone_feasibility(catalog, node_view)
         best = None
         for level in range(MAX_LEVEL + 1):
-            lowered = lower_pods(pods, nodes=self.cluster.nodes.values(),
+            lowered = lower_pods(pods, nodes=node_view,
                                  option_zones=zones, zone_rank=zone_rank,
                                  level=level, zone_feasible=zone_feasible)
             problem = tensorize(lowered, catalog, pools,
                                 node_classes=getattr(self.provider,
                                                      "node_classes", None))
-            if schedule_on_existing and self.cluster.nodes:
+            if schedule_on_existing and node_view:
                 node_list, alloc, used, compat = self.cluster.tensorize_nodes(
-                    problem.class_reps, problem.axes, scales=problem.scales)
+                    problem.class_reps, problem.axes, scales=problem.scales,
+                    nodes=node_view)
                 solve = self._pick_solver(problem, n_existing=len(node_list))
                 result = solve(problem, max_nodes=self.max_nodes_per_round,
                                existing_alloc=alloc, existing_used=used,

@@ -113,11 +113,20 @@ class Operator:
             clock=clock)
 
         self.cluster = Cluster(clock=clock)
-        # scrape-time state gauges: per-node allocatable/requests, pod phases
-        # (reference karpenter_nodes_allocatable / _total_pod_requests /
-        # karpenter_pods_state) — refreshed on /metrics, stale series dropped
+        # one state lock shared by the tick loop (ControllerManager), the
+        # /v1 surface, and the metrics collector — scrapes and solves must
+        # never iterate cluster state mid-mutation (advisor r4)
+        import threading
+        self.state_lock = threading.Lock()
+        # pre-register every parity family so the first scrape serves the
+        # complete reference schema (zero samples beat absent families)
+        metrics.register_parity_families()
+        # scrape-time state gauges: per-node allocatable/overhead/requests/
+        # limits (pod + daemon splits), pod phases — refreshed on /metrics,
+        # stale series dropped
         metrics.REGISTRY.add_collector(
-            metrics.make_cluster_collector(self.cluster))
+            metrics.make_cluster_collector(self.cluster,
+                                           lock=self.state_lock))
         self.node_classes: Dict[str, NodeClass] = {"default": NodeClass()}
         self.nodepools: Dict[str, NodePool] = {"default": NodePool()}
         self.cloud_provider = CloudProvider(

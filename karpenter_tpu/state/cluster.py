@@ -233,6 +233,27 @@ class Cluster:
         return all(budgets[name] >= n for name, n in draw.items())
 
     # ---- tensorization of live capacity ----
+    def snapshot_nodes(self) -> List[Node]:
+        """Point-in-time node copies for lock-free solves: shallow node
+        copies with their pods list, labels dict, and taints list copied —
+        a concurrent tick's bind/remove AND the lifecycle controller's
+        label/taint edits (initialized marker, startup-taint removal)
+        cannot change them mid-solve.  Taken under the caller's state
+        lock in microseconds; everything downstream
+        (`tensorize_nodes(nodes=…)`, constraint lowering) then runs off
+        the lock.  Pod objects themselves are shared — the solver only
+        reads fields that are stable after admission — so the copy is
+        O(nodes + pods) pointers, not a deep clone."""
+        import copy
+        out = []
+        for n in self.nodes.values():
+            c = copy.copy(n)
+            c.pods = list(n.pods)
+            c.labels = dict(n.labels)
+            c.taints = list(n.taints)
+            out.append(c)
+        return out
+
     def tensorize_nodes(self, pod_classes: Sequence[Pod],
                         axes: Tuple[str, ...] = DEFAULT_AXES,
                         exclude: Sequence[str] = (),

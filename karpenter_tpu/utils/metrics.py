@@ -198,7 +198,12 @@ class Registry:
             self._collectors.clear()
 
     def expose(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format.  Families with a legacy
+        alias (the reference ships BOTH API generations' names,
+        metrics.md:30-195 — machines_* beside nodeclaims_*,
+        deprovisioning_* beside disruption_*) are emitted twice: once
+        under the current name and once, sample-for-sample, under the
+        alias, so dashboards written against either generation scrape."""
         with self._lock:
             collectors = list(self._collectors)
         for fn in collectors:
@@ -206,17 +211,51 @@ class Registry:
         lines = []
         with self._lock:
             metrics = list(self._metrics.values())
-        for m in sorted(metrics, key=lambda m: m.name):
-            lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+
+        def emit(m, out_name):
+            lines.append(f"# HELP {out_name} {m.help}")
+            lines.append(f"# TYPE {out_name} {m.kind}")
             for name, labelkv, value in m.samples():
+                name = out_name + name[len(m.name):]   # keeps _bucket/_sum
                 if labelkv:
                     lbl = ",".join(f'{k}="{v}"' for k, v in labelkv)
                     lines.append(f"{name}{{{lbl}}} {value}")
                 else:
                     lines.append(f"{name} {value}")
+
+        for m in sorted(metrics, key=lambda m: m.name):
+            emit(m, m.name)
+            alias = LEGACY_ALIASES.get(m.name)
+            if alias:
+                emit(m, alias)
         return "\n".join(lines) + "\n"
 
+
+# current-generation family → legacy (v1alpha5) alias, both served from
+# one store (reference ships both name generations side by side)
+LEGACY_ALIASES = {
+    "karpenter_nodeclaims_created": "karpenter_machines_created",
+    "karpenter_nodeclaims_disrupted": "karpenter_machines_disrupted",
+    "karpenter_nodeclaims_drifted": "karpenter_machines_drifted",
+    "karpenter_nodeclaims_initialized": "karpenter_machines_initialized",
+    "karpenter_nodeclaims_launched": "karpenter_machines_launched",
+    "karpenter_nodeclaims_registered": "karpenter_machines_registered",
+    "karpenter_nodeclaims_terminated": "karpenter_machines_terminated",
+    "karpenter_disruption_actions_performed_total":
+        "karpenter_deprovisioning_actions_performed",
+    "karpenter_disruption_consolidation_timeouts_total":
+        "karpenter_deprovisioning_consolidation_timeouts",
+    "karpenter_disruption_eligible_nodes":
+        "karpenter_deprovisioning_eligible_machines",
+    "karpenter_disruption_evaluation_duration_seconds":
+        "karpenter_deprovisioning_evaluation_duration_seconds",
+    "karpenter_disruption_replacement_nodeclaim_initialized_seconds":
+        "karpenter_deprovisioning_replacement_machine_initialized_seconds",
+    "karpenter_disruption_replacement_nodeclaim_failures_total":
+        "karpenter_deprovisioning_replacement_machine_launch_failure_counter",
+    "karpenter_nodepool_limit": "karpenter_provisioner_limit",
+    "karpenter_nodepool_usage": "karpenter_provisioner_usage",
+}
 
 # Process-default registry + the parity-named families used across the
 # framework (names follow metrics.md; subsystem prefix karpenter_).
@@ -246,6 +285,16 @@ def batch_window_duration() -> Histogram:
     return REGISTRY.histogram(
         "karpenter_cloudprovider_batcher_batch_time_seconds",
         "Batch window open duration.", labels=("batcher",))
+
+
+def interruption_actions() -> Counter:
+    """Actions taken for interruption messages (reference
+    karpenter_interruption_actions_performed,
+    pkg/controllers/interruption/metrics.go:36-62)."""
+    return REGISTRY.counter(
+        "karpenter_interruption_actions_performed",
+        "Actions performed in response to interruption messages.",
+        labels=("action",))
 
 
 def interruption_received() -> Counter:
@@ -300,8 +349,20 @@ def nodeclaims_terminated() -> Counter:
 
 def disruption_actions() -> Counter:
     return REGISTRY.counter(
-        "karpenter_disruption_actions_performed",
+        "karpenter_disruption_actions_performed_total",
         "Disruption actions executed.", labels=("action", "method"))
+
+
+def disruption_replacement_initialized() -> Histogram:
+    """Launch→live latency of disruption replacement nodes (reference
+    karpenter_disruption_replacement_nodeclaim_initialized_seconds).  In
+    this substrate replacements go live at registration, so the observed
+    span is create-call → registered — the same boundary the fake cloud's
+    launch path owns."""
+    return REGISTRY.histogram(
+        "karpenter_disruption_replacement_nodeclaim_initialized_seconds",
+        "Time to initialize a disruption replacement node.",
+        buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 15, 30, 60, 120, 300))
 
 
 def pods_unschedulable() -> Gauge:
@@ -317,6 +378,13 @@ def disruption_evaluation_duration() -> Histogram:
     return REGISTRY.histogram(
         "karpenter_disruption_evaluation_duration_seconds",
         "Duration of one disruption reconcile evaluation.",
+        labels=("method",))
+
+
+def consolidation_timeouts() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_disruption_consolidation_timeouts_total",
+        "Disruption evaluations that exceeded the consolidation budget.",
         labels=("method",))
 
 
@@ -486,38 +554,159 @@ def nodes_pod_requests() -> Gauge:
         labels=("node_name", "nodepool", "resource_type"))
 
 
-def make_cluster_collector(cluster):
-    """Scrape-time collector for per-node and pod-phase gauges.  Refreshes
-    karpenter_nodes_allocatable / karpenter_nodes_total_pod_requests /
-    karpenter_pods_state from live cluster state and deletes series for
-    nodes that have since terminated."""
+def nodes_pod_limits() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_nodes_total_pod_limits",
+        "Sum of scheduled pod limits per node.",
+        labels=("node_name", "nodepool", "resource_type"))
+
+
+def nodes_daemon_requests() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_nodes_total_daemon_requests",
+        "Sum of daemonset pod requests per node.",
+        labels=("node_name", "nodepool", "resource_type"))
+
+
+def nodes_daemon_limits() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_nodes_total_daemon_limits",
+        "Sum of daemonset pod limits per node.",
+        labels=("node_name", "nodepool", "resource_type"))
+
+
+def nodes_system_overhead() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_nodes_system_overhead",
+        "Capacity minus allocatable per node (kube/system reserved + "
+        "eviction threshold).",
+        labels=("node_name", "nodepool", "resource_type"))
+
+
+def nodepool_usage_pct() -> Gauge:
+    """Legacy karpenter_provisioner_usage_pct (v1alpha5): usage as a
+    percentage of the pool's limit, computed where usage/limit are set."""
+    return REGISTRY.gauge(
+        "karpenter_provisioner_usage_pct",
+        "Nodepool usage as a percentage of its limit (legacy alias).",
+        labels=("nodepool", "resource_type"))
+
+
+def controller_reconciles() -> Counter:
+    return REGISTRY.counter(
+        "controller_runtime_reconcile_total",
+        "Reconciles per controller.", labels=("controller",))
+
+
+def controller_reconcile_errors() -> Counter:
+    return REGISTRY.counter(
+        "controller_runtime_reconcile_errors_total",
+        "Reconcile errors per controller.", labels=("controller",))
+
+
+def controller_reconcile_time() -> Histogram:
+    return REGISTRY.histogram(
+        "controller_runtime_reconcile_time_seconds",
+        "Reconcile latency per controller.", labels=("controller",))
+
+
+def controller_active_workers() -> Gauge:
+    return REGISTRY.gauge(
+        "controller_runtime_active_workers",
+        "Workers currently reconciling (singleton loops: 0 or 1).",
+        labels=("controller",))
+
+
+def controller_max_concurrent() -> Gauge:
+    return REGISTRY.gauge(
+        "controller_runtime_max_concurrent_reconciles",
+        "Configured concurrency per controller (singleton loops: 1).",
+        labels=("controller",))
+
+
+def make_cluster_collector(cluster, lock=None):
+    """Scrape-time collector for per-node and pod-phase gauges: refreshes
+    karpenter_nodes_{allocatable, system_overhead, total_pod_requests,
+    total_pod_limits, total_daemon_requests, total_daemon_limits} and
+    karpenter_pods_state from live cluster state, deleting series for
+    nodes that have since terminated.
+
+    `lock` is the tick loop's state lock (advisor r4: collectors run on
+    /metrics HTTP threads, and sweeping cluster.pods/node.pods while a
+    tick binds or removes raises mid-iteration); a private lock guards
+    prev_keys against concurrent scrapes."""
+    import contextlib
     prev_keys: set = set()
+    my_lock = threading.Lock()
+
+    FAMS = {"a": nodes_allocatable, "o": nodes_system_overhead,
+            "r": nodes_pod_requests, "l": nodes_pod_limits,
+            "dr": nodes_daemon_requests, "dl": nodes_daemon_limits}
 
     def collect():
         nonlocal prev_keys
-        alloc_g, req_g, state_g = (nodes_allocatable(), nodes_pod_requests(),
-                                   pods_state())
+        gauges = {k: f() for k, f in FAMS.items()}
+        state_g = pods_state()
         cur: set = set()
-        pending = bound = 0
-        for p in cluster.pods.values():
-            if p.node_name:
-                bound += 1
-            else:
-                pending += 1
-        state_g.set(pending, {"phase": "pending"})
-        state_g.set(bound, {"phase": "running"})
-        for n in list(cluster.nodes.values()):
-            base = {"node_name": n.name, "nodepool": n.nodepool or ""}
-            for res, qty in n.allocatable.items():
-                alloc_g.set(qty, {**base, "resource_type": res})
-                cur.add(("a", n.name, n.nodepool or "", res))
-            for res, qty in n.requested().items():
-                req_g.set(qty, {**base, "resource_type": res})
-                cur.add(("r", n.name, n.nodepool or "", res))
-        for kind, name, pool, res in prev_keys - cur:
-            g = alloc_g if kind == "a" else req_g
-            g.delete({"node_name": name, "nodepool": pool,
-                      "resource_type": res})
-        prev_keys = cur
+        with my_lock, (lock if lock is not None
+                       else contextlib.nullcontext()):
+            pending = bound = 0
+            for p in cluster.pods.values():
+                if p.node_name:
+                    bound += 1
+                else:
+                    pending += 1
+            state_g.set(pending, {"phase": "pending"})
+            state_g.set(bound, {"phase": "running"})
+            from ..api.resources import ResourceList as _RL
+
+            def put(kind, base, rl):
+                for res, qty in rl.items():
+                    gauges[kind].set(qty, {**base, "resource_type": res})
+                    cur.add((kind, base["node_name"], base["nodepool"], res))
+
+            for n in list(cluster.nodes.values()):
+                base = {"node_name": n.name, "nodepool": n.nodepool or ""}
+                put("a", base, n.allocatable)
+                put("o", base,
+                    (n.capacity - n.allocatable).clamp_nonnegative()
+                    if n.capacity else _RL())
+                req, lim, dreq, dlim = _RL(), _RL(), _RL(), _RL()
+                for p in n.pods:
+                    req = req + p.requests
+                    lim = lim + p.limits
+                    if p.is_daemon:
+                        dreq = dreq + p.requests
+                        dlim = dlim + p.limits
+                put("r", base, req)
+                put("l", base, lim)
+                put("dr", base, dreq)
+                put("dl", base, dlim)
+            for kind, name, pool, res in prev_keys - cur:
+                gauges[kind].delete({"node_name": name, "nodepool": pool,
+                                     "resource_type": res})
+            prev_keys = cur
 
     return collect
+
+
+def register_parity_families() -> None:
+    """Touch every parity-named family so one scrape exposes the complete
+    schema from process start (standard Prometheus-client practice: zero
+    samples beat absent families for dashboards and alerts).  Called by
+    the operator at startup; tests use it to assert the reference's
+    metrics page is served in full."""
+    import inspect
+    import sys
+    mod = sys.modules[__name__]
+    for name, fn in vars(mod).items():
+        if name in ("make_cluster_collector", "register_parity_families"):
+            continue
+        if not inspect.isfunction(fn):
+            continue
+        sig = inspect.signature(fn)
+        if sig.parameters:
+            continue
+        ret = sig.return_annotation
+        if ret in ("Counter", "Gauge", "Histogram", Counter, Gauge, Histogram):
+            fn()

@@ -215,6 +215,155 @@ def test_cluster_collector_refreshes_and_drops_stale_series():
     assert not any(("node_name", node.name) in key for _, key, _ in series)
 
 
+REFERENCE_FAMILIES = [
+    # the COMPLETE karpenter_* + controller_runtime_* enumeration of the
+    # reference's metrics page (metrics.md:30-195), asserted family by
+    # family (r4 verdict #5: close the enumeration).  The only exclusion:
+    # karpenter_nodes_leases_deleted — the model has no kubelet Lease
+    # objects, documented in docs/metrics.md.
+    "controller_runtime_active_workers",
+    "controller_runtime_max_concurrent_reconciles",
+    "controller_runtime_reconcile_errors_total",
+    "controller_runtime_reconcile_time_seconds",
+    "controller_runtime_reconcile_total",
+    "karpenter_consistency_errors",
+    "karpenter_deprovisioning_actions_performed",
+    "karpenter_deprovisioning_consolidation_timeouts",
+    "karpenter_deprovisioning_eligible_machines",
+    "karpenter_deprovisioning_evaluation_duration_seconds",
+    "karpenter_deprovisioning_replacement_machine_initialized_seconds",
+    "karpenter_deprovisioning_replacement_machine_launch_failure_counter",
+    "karpenter_disruption_actions_performed_total",
+    "karpenter_disruption_consolidation_timeouts_total",
+    "karpenter_disruption_eligible_nodes",
+    "karpenter_disruption_evaluation_duration_seconds",
+    "karpenter_disruption_replacement_nodeclaim_failures_total",
+    "karpenter_disruption_replacement_nodeclaim_initialized_seconds",
+    "karpenter_interruption_actions_performed",
+    "karpenter_interruption_deleted_messages",
+    "karpenter_interruption_message_latency_time_seconds",
+    "karpenter_interruption_received_messages",
+    "karpenter_machines_created",
+    "karpenter_machines_disrupted",
+    "karpenter_machines_drifted",
+    "karpenter_machines_initialized",
+    "karpenter_machines_launched",
+    "karpenter_machines_registered",
+    "karpenter_machines_terminated",
+    "karpenter_nodeclaims_created",
+    "karpenter_nodeclaims_disrupted",
+    "karpenter_nodeclaims_drifted",
+    "karpenter_nodeclaims_initialized",
+    "karpenter_nodeclaims_launched",
+    "karpenter_nodeclaims_registered",
+    "karpenter_nodeclaims_terminated",
+    "karpenter_nodepool_limit",
+    "karpenter_nodepool_usage",
+    "karpenter_provisioner_limit",
+    "karpenter_provisioner_scheduling_duration_seconds",
+    "karpenter_provisioner_scheduling_simulation_duration_seconds",
+    "karpenter_provisioner_usage",
+    "karpenter_provisioner_usage_pct",
+    "karpenter_nodes_allocatable",
+    "karpenter_nodes_created",
+    "karpenter_nodes_system_overhead",
+    "karpenter_nodes_terminated",
+    "karpenter_nodes_termination_time_seconds",
+    "karpenter_nodes_total_daemon_limits",
+    "karpenter_nodes_total_daemon_requests",
+    "karpenter_nodes_total_pod_limits",
+    "karpenter_nodes_total_pod_requests",
+    "karpenter_pods_startup_time_seconds",
+    "karpenter_pods_state",
+    "karpenter_cloudprovider_duration_seconds",
+    "karpenter_cloudprovider_errors_total",
+    "karpenter_cloudprovider_instance_type_cpu_cores",
+    "karpenter_cloudprovider_instance_type_memory_bytes",
+    "karpenter_cloudprovider_instance_type_price_estimate",
+]
+
+
+def test_reference_metrics_enumeration_complete():
+    """Every family on the reference's metrics page is served (most as
+    first-class families, legacy generations as exact sample aliases)."""
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.operator.manager import ControllerManager
+    from karpenter_tpu.operator.operator import build_controllers
+    from karpenter_tpu.catalog.generate import generate_catalog
+    clock = [100.0]
+    op = Operator(Options(), catalog=generate_catalog(4),
+                  clock=lambda: clock[0])
+    mgr = ControllerManager(op, build_controllers(op),
+                            clock=lambda: clock[0])
+    # NO manual family touches: Operator.__init__'s
+    # register_parity_families() must register the whole schema by itself
+    # — this test exists to catch that discovery silently missing one
+    op.cluster.add_pods([cpu_pod(cpu_m=200)])
+    clock[0] += 20.0
+    mgr.tick()
+    text = metrics.REGISTRY.expose()
+    missing = [f for f in REFERENCE_FAMILIES
+               if f"# TYPE {f} " not in text]
+    assert not missing, f"families missing from /metrics: {missing}"
+
+
+def test_legacy_aliases_mirror_samples():
+    """A legacy-alias family reports exactly the current family's
+    samples, renamed."""
+    c = metrics.nodeclaims_created()
+    c.inc({"nodepool": "p1"})
+    text = metrics.REGISTRY.expose()
+    cur = [ln for ln in text.splitlines()
+           if ln.startswith("karpenter_nodeclaims_created{")]
+    legacy = [ln for ln in text.splitlines()
+              if ln.startswith("karpenter_machines_created{")]
+    assert cur and legacy
+    assert [ln.split("{", 1)[1] for ln in cur] == \
+        [ln.split("{", 1)[1] for ln in legacy]
+
+
+def test_collector_safe_under_concurrent_mutation():
+    """/metrics scrapes share the tick loop's state lock: hammering
+    expose() while pods bind/unbind must never raise (advisor r4:
+    'dictionary changed size during iteration')."""
+    import threading
+    pools = [NodePool()]
+    clock, cluster, prov, provider = env(pools)
+    lock = threading.Lock()
+    metrics.REGISTRY.add_collector(
+        metrics.make_cluster_collector(cluster, lock=lock))
+    cluster.add_pods([cpu_pod(cpu_m=300) for _ in range(8)])
+    prov.provision()
+    errs = []
+    stop = threading.Event()
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                metrics.REGISTRY.expose()
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+    threads = [threading.Thread(target=scrape) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(60):
+            with lock:
+                if i % 2:
+                    cluster.add_pods([cpu_pod(cpu_m=100)])
+                else:
+                    pend = cluster.pending_pods()
+                    if pend:
+                        cluster.delete_pod(pend[0])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errs, errs
+
+
 def test_pods_startup_time_sync_and_async_paths():
     from karpenter_tpu.controllers.lifecycle import LifecycleController
     from karpenter_tpu.api.objects import NodeClaim
