@@ -164,6 +164,7 @@ class KubeletConfiguration:
     kube_reserved: ResourceList = field(default_factory=ResourceList)
     system_reserved: ResourceList = field(default_factory=ResourceList)
     eviction_hard: ResourceList = field(default_factory=ResourceList)
+    eviction_soft: ResourceList = field(default_factory=ResourceList)
     cluster_dns: tuple = ()  # node DNS resolver list (v4 or v6), primary
                              # first; () == use the discovered kube-dns.
                              # A bare string is accepted and normalized.

@@ -90,6 +90,9 @@ def nodepool_to_manifest(pool: NodePool) -> Dict:
         if kc.eviction_hard:
             kd["evictionHard"] = {k: format_quantity(v, k)
                                   for k, v in kc.eviction_hard.items()}
+        if kc.eviction_soft:
+            kd["evictionSoft"] = {k: format_quantity(v, k)
+                                  for k, v in kc.eviction_soft.items()}
         if kc.cluster_dns:
             kd["clusterDNS"] = list(kc.cluster_dns)
         spec["template"]["spec"]["kubelet"] = kd
@@ -134,6 +137,7 @@ def _kubelet_from_dict(d: Dict) -> KubeletConfiguration:
         kube_reserved=ResourceList.parse(d.get("kubeReserved", {}) or {}),
         system_reserved=ResourceList.parse(d.get("systemReserved", {}) or {}),
         eviction_hard=ResourceList.parse(d.get("evictionHard", {}) or {}),
+        eviction_soft=ResourceList.parse(d.get("evictionSoft", {}) or {}),
         cluster_dns=tuple(dns),
     )
 

@@ -132,12 +132,19 @@ def system_reserved(kubelet: Optional[KubeletConfiguration] = None) -> ResourceL
 def eviction_threshold(memory_bytes: int, storage_bytes: int,
                        kubelet: Optional[KubeletConfiguration] = None) -> ResourceList:
     """100Mi memory + 10% storage hard-eviction defaults, kubelet overrides
-    (/root/reference/pkg/providers/instancetype/types.go:370-399)."""
+    (/root/reference/pkg/providers/instancetype/types.go:370-399): the
+    MAX across eviction signals (hard vs soft) per resource, and that
+    maximum REPLACES the default — an operator configuring a threshold
+    below 100Mi gets exactly what they configured (the old max-with-
+    default rule silently kept the default; review r5 golden cases)."""
     out = ResourceList({MEMORY: 100 * MiB,
                         EPHEMERAL_STORAGE: int(math.ceil(storage_bytes / 10))})
-    if kubelet and kubelet.eviction_hard:
-        for k, v in kubelet.eviction_hard.items():
-            out[k] = max(out.get(k, 0), v)
+    if kubelet:
+        override = ResourceList()
+        for signal in (kubelet.eviction_hard, kubelet.eviction_soft):
+            for k, v in (signal or {}).items():
+                override[k] = max(override.get(k, 0), v)
+        out.update(override)
     return out
 
 

@@ -489,17 +489,52 @@ class CloudProvider:
                 return "NodePoolDrifted"
         nc = self.node_classes.get(claim.node_class_ref)
         if nc is not None:
+            # the reference's precedence (drift.go:42-67): static fields
+            # first — it saves the instance lookup — then the live
+            # instance's AMI, security groups, and subnet against the
+            # nodeclass's resolved status, first hit wins
             if claim.node_class_hash:
                 from ..controllers.nodeclass import static_hash
                 current = nc.hash_annotation or static_hash(nc)
                 if claim.node_class_hash != current:
                     return "NodeClassHashDrifted"
-            # AMI drift (drift.go:42-67 isNodeClassDrifted → amiDrifted): a
-            # newer image published under the same selector resolves into
-            # status_images and drifts every node booted from the old one
-            if (claim.image_id and nc.status_images
-                    and claim.image_id not in nc.status_images):
+            instance = None
+            if claim.provider_id:
+                try:
+                    instance = self.cloud.get_instance(claim.provider_id)
+                except Exception as e:
+                    # live SG/subnet checks are skipped this pass and the
+                    # next reconcile retries — but never silently
+                    # (review r5: an unlogged skip is indistinguishable
+                    # from a no-drift verdict)
+                    log.warning(
+                        "drift check for %s: instance %s lookup failed "
+                        "(%s); static checks only this pass",
+                        claim.name, claim.provider_id, e)
+                    instance = None
+            # AMI drift (isAMIDrifted): a newer image published under the
+            # same selector resolves into status_images and drifts every
+            # node booted from the old one; prefer the live instance's AMI
+            image = (instance.image_id if instance is not None
+                     and instance.image_id else claim.image_id)
+            if image and nc.status_images and image not in nc.status_images:
                 return "ImageDrifted"
+            # security-group drift (areSecurityGroupsDrifted): the launch
+            # template the instance booted from carries its SG set — any
+            # mismatch with the nodeclass's resolved set drifts
+            if (instance is not None and instance.launch_template
+                    and nc.status_security_groups):
+                lt = getattr(self.cloud, "launch_templates", {}).get(
+                    instance.launch_template)
+                if lt is not None and set(lt.security_group_ids) != \
+                        set(nc.status_security_groups):
+                    return "SecurityGroupDrifted"
+            # subnet drift (isSubnetDrifted): instance's subnet no longer
+            # among the nodeclass's resolved subnets
+            if (instance is not None and instance.subnet_id
+                    and nc.status_subnets
+                    and instance.subnet_id not in nc.status_subnets):
+                return "SubnetDrifted"
             if nc.status_zones and claim.zone not in nc.status_zones:
                 return "ZoneDrifted"
         return None

@@ -177,6 +177,13 @@ class ControllerManager:
                 reconcile = ctrl.reconcile
             self._entries.append(_Entry(
                 name, reconcile, self.DEFAULT_INTERVALS.get(name, 10.0)))
+            # static controller-runtime gauges, set ONCE: singleton loops
+            # have concurrency 1, and active_workers reads 0 from any
+            # scrape because reconciles run under the same state lock the
+            # collector takes — the family documents the loop model, it
+            # cannot be caught mid-flight
+            metrics.controller_max_concurrent().set(1, {"controller": name})
+            metrics.controller_active_workers().set(0, {"controller": name})
         self._stop = threading.Event()
         self._http: Optional[http.server.ThreadingHTTPServer] = None
         # serializes cluster-state access between the tick loop, the /v1
@@ -216,10 +223,6 @@ class ControllerManager:
             if now - e.last_run < e.interval:
                 continue
             e.last_run = now
-            # controller-runtime-parity families: reconcile counts/errors/
-            # latency plus worker gauges (singleton loops: concurrency 1)
-            metrics.controller_max_concurrent().set(1, {"controller": e.name})
-            metrics.controller_active_workers().set(1, {"controller": e.name})
             t0 = time.perf_counter()
             try:
                 results[e.name] = e.reconcile()
@@ -231,8 +234,6 @@ class ControllerManager:
                 metrics.controller_reconciles().inc({"controller": e.name})
                 metrics.controller_reconcile_time().observe(
                     time.perf_counter() - t0, {"controller": e.name})
-                metrics.controller_active_workers().set(
-                    0, {"controller": e.name})
         return results
 
     def run(self, tick_seconds: float = 0.25,
@@ -267,9 +268,13 @@ class ControllerManager:
         prov = self.controllers.get("provisioning")
         if prov is None:
             raise ValueError("no provisioning controller wired")
+        raw = payload.get("pods", [])
+        if not isinstance(raw, list) or any(not isinstance(p, dict)
+                                            for p in raw):
+            raise BadRequest("\"pods\" must be a list of Pod manifests")
         try:
-            pods = [pod_from_manifest(p) for p in payload.get("pods", [])]
-        except (ValueError, KeyError, TypeError) as e:
+            pods = [pod_from_manifest(p) for p in raw]
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
             raise BadRequest(f"bad pod manifest: {e}") from e
         if not pods:
             raise BadRequest("no pods in request")
@@ -332,15 +337,24 @@ class ControllerManager:
         if not manifests:
             raise BadRequest("no manifests in request (expected a manifest "
                              "object or {\"manifests\": [...]})")
+        for m in manifests:
+            if not isinstance(m, dict):
+                raise BadRequest(f"bad manifest entry {m!r}: not an object")
         applied = []
         with self._state_lock:
+            # two-phase so a 400 means NOTHING was applied: validate the
+            # whole batch first, register second (review r5: the old
+            # single pass left earlier manifests live behind a 400)
             for m in manifests:
                 try:
-                    obj = self.operator.apply(m)
-                except (ValueError, KeyError, TypeError) as e:
+                    self.operator.validate(m)
+                except (ValueError, KeyError, TypeError,
+                        AttributeError) as e:
                     raise BadRequest(
                         f"admission failed for {m.get('kind')}/"
                         f"{m.get('metadata', {}).get('name')}: {e}") from e
+            for m in manifests:
+                obj = self.operator.apply(m)
                 applied.append({"kind": m.get("kind"),
                                 "name": getattr(obj, "name", None)})
         return {"applied": applied}
@@ -362,29 +376,43 @@ class ControllerManager:
 
     def feedback_request(self, payload: Dict) -> Dict:
         """POST /v1/feedback — launch-result feedback from the external
-        actuator: failed launches (ICE and friends) mark the offering
-        unavailable in the same cache the internal launch path feeds, so
-        the next /v1/solve avoids the pool (r4 verdict: 'no way for an
-        external caller to feed launch results/ICE back')."""
+        actuator.  Failed launches whose error CLASSIFIES as exhausted
+        capacity (the same cloud/errors.py taxonomy the internal launch
+        path gates on — an external RequestLimitExceeded throttle must
+        not blacklist healthy capacity) mark the offering unavailable, so
+        the next /v1/solve avoids the pool.  The whole batch is validated
+        BEFORE any entry takes effect: a 400 means nothing was applied,
+        so 'fix and resend' is safe."""
+        from ..cloud.errors import is_unfulfillable_capacity
+        from ..cloud.fake import CloudError
         results = payload.get("results")
         if not isinstance(results, list) or not results:
             raise BadRequest("no results in request (expected "
                              "{\"results\": [{instanceType, zone, "
                              "capacityType, ok, error?}, ...]})")
-        unavailable = self.operator.cloud_provider.unavailable
-        marked = 0
+        failures = []
         for r in results:
+            if not isinstance(r, dict):
+                raise BadRequest(f"bad result entry {r!r}: not an object")
+            if bool(r.get("ok", False)):
+                continue
             try:
-                ok = bool(r.get("ok", False))
-                if ok:
-                    continue
+                failures.append((str(r.get("error", "LaunchFailed")),
+                                 r["instanceType"], r["zone"],
+                                 r["capacityType"]))
+            except KeyError as e:
+                raise BadRequest(f"bad result entry {r!r}: missing {e}") \
+                    from e
+        unavailable = self.operator.cloud_provider.unavailable
+        marked = ignored = 0
+        for code, itype, zone, captype in failures:
+            if is_unfulfillable_capacity(CloudError(code)):
                 unavailable.mark_unavailable_for_fleet_err(
-                    str(r.get("error", "LaunchFailed")),
-                    r["instanceType"], r["zone"], r["capacityType"])
+                    code, itype, zone, captype)
                 marked += 1
-            except (KeyError, TypeError) as e:
-                raise BadRequest(f"bad result entry {r!r}: {e}") from e
-        return {"markedUnavailable": marked,
+            else:
+                ignored += 1   # transient fault — retry, don't blacklist
+        return {"markedUnavailable": marked, "ignored": ignored,
                 "unavailableSeq": unavailable.seq_num}
 
     def serve_endpoints(self, metrics_port: Optional[int] = None,

@@ -168,6 +168,28 @@ class Operator:
             log.info("hydrated %d nodes from cloud state", n)
         return n
 
+    def validate(self, manifest: Dict) -> None:
+        """Dry-run admission: everything `apply` would check — legacy
+        conversion, schema validation, defaulting-time parsing, update
+        immutability — WITHOUT registering anything.  Lets batch callers
+        (/v1/apply) reject the whole batch before any member takes
+        effect."""
+        from ..api.admission import validate_manifest, validate_nodeclass_update
+        from ..api.legacy import convert_manifest
+        from ..api.serialize import (nodeclass_from_manifest,
+                                     nodepool_from_manifest)
+        validate_manifest(manifest)
+        manifest = convert_manifest(manifest)
+        validate_manifest(manifest)
+        kind = manifest.get("kind")
+        if kind == "NodePool":
+            nodepool_from_manifest(manifest)
+        elif kind == "NodeClass":
+            nc = nodeclass_from_manifest(manifest)
+            original = self.node_classes.get(nc.name)
+            if original is not None:
+                validate_nodeclass_update(original, nc)
+
     def apply(self, manifest: Dict):
         """Admission-checked manifest ingestion — the kubectl-apply analog:
         default + validate (webhook semantics, pkg/webhooks/webhooks.go:44-63)

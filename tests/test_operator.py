@@ -333,6 +333,36 @@ class TestControllerManager:
             assert all((n["instanceType"], n["zone"], n["capacityType"])
                        != offending for n in plan2["nodes"])
             assert not plan2["unschedulable"]
+            # a transient throttle must NOT blacklist healthy capacity —
+            # only errors classifying as exhausted capacity mark the cache
+            fb2 = post("/v1/feedback", {"results": [
+                {"instanceType": nd["instanceType"], "zone": nd["zone"],
+                 "capacityType": nd["capacityType"], "ok": False,
+                 "error": "RequestLimitExceeded"}]})
+            assert fb2["markedUnavailable"] == 0 and fb2["ignored"] == 1
+            # /v1/apply is atomic: a bad manifest in the batch rejects the
+            # WHOLE batch (nothing before it stays applied)
+            good = nodepool_to_manifest(NodePool(name="atomic-probe"))
+            err2 = post("/v1/apply", {"manifests": [good, bad]}, expect=400)
+            assert "error" in err2
+            listed2 = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/nodepools", timeout=10).read())
+            assert "atomic-probe" not in {
+                i["metadata"]["name"] for i in listed2["items"]}
+            # wrong-shape payloads are 400s, not 500 retry-me faults
+            post("/v1/solve", {"pods": "oops"}, expect=400)
+            post("/v1/feedback", {"results": ["oops"]}, expect=400)
+            # validation precedes side effects: a batch with one malformed
+            # entry marks nothing
+            seq_before = fb2["unavailableSeq"]
+            post("/v1/feedback", {"results": [
+                {"instanceType": "x", "zone": "z", "capacityType": "spot",
+                 "ok": False, "error": "InsufficientInstanceCapacity"},
+                {"ok": False}]}, expect=400)
+            fb3 = post("/v1/feedback", {"results": [
+                {"instanceType": "y", "zone": "z", "capacityType": "spot",
+                 "ok": True}]})
+            assert fb3["unavailableSeq"] == seq_before
             # malformed feedback / bad JSON are client errors
             post("/v1/feedback", {"results": [{"ok": False}]}, expect=400)
             req = urllib.request.Request(

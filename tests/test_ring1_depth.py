@@ -1,0 +1,308 @@
+"""Ring-1 depth: the reference behaviors whose suites are exhaustive
+upstream, pinned here case by case (r4 verdict #6).
+
+Covers: golden kube-reserved/eviction overhead math against hand-computed
+values from the reference formulas (types.go:333-416), the full drift
+matrix with its precedence order (drift.go:42-67), launch-template cache
+eviction/invalidation semantics (launchtemplate.go:137-146), and
+interruption event-parsing edge cases (parser.go:54-80)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import (KubeletConfiguration, NodeClaim,
+                                       NodeClass)
+from karpenter_tpu.api.resources import (CPU, EPHEMERAL_STORAGE, MEMORY,
+                                         ResourceList)
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.catalog.instancetype import (GiB, MiB, eviction_threshold,
+                                                kube_reserved)
+from karpenter_tpu.cloud.fake import (FakeCloud, ImageInfo, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.cloud.provider import CloudProvider
+from karpenter_tpu.cloud.queue import (NOOP, SCHEDULED_CHANGE,
+                                       SPOT_INTERRUPTION, STATE_CHANGE,
+                                       make_event_body, parse_event)
+from karpenter_tpu.cloud.services import FakeControlPlane, FakeParameterStore
+from karpenter_tpu.providers.imagefamily import ImageProvider, Resolver
+from karpenter_tpu.providers.launchtemplate import (LaunchTemplateProvider,
+                                                    template_name)
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.providers.version import VersionProvider
+
+
+class TestGoldenOverheadMath:
+    """kube_reserved / eviction_threshold against values computed BY HAND
+    from the reference's graduated table (types.go:333-367): 6% of the
+    first core, 1% of the second, 0.5% of cores 3-4, 0.25% beyond, plus
+    11Mi/pod + 255Mi memory and 1Gi ephemeral kube-reserved."""
+
+    @pytest.mark.parametrize("cpu_m,expected_cpu_m", [
+        (500, 30),          # 500 × 6%
+        (1000, 60),         # full first core
+        (1500, 65),         # 60 + 500 × 1%
+        (2000, 70),         # 60 + 10
+        (3000, 75),         # 70 + 1000 × 0.5%
+        (4000, 80),         # 70 + 10
+        (8000, 90),         # 80 + 4000 × 0.25%
+        (16000, 110),       # 80 + 12000 × 0.25%
+        (64000, 230),       # 80 + 60000 × 0.25%
+        (96000, 310),       # 80 + 92000 × 0.25%
+        (2100, 70),         # 60 + 10 + int(100 × 0.5%) = 70 (truncates)
+    ])
+    def test_graduated_cpu(self, cpu_m, expected_cpu_m):
+        assert kube_reserved(cpu_m, 110)[CPU] == expected_cpu_m
+
+    @pytest.mark.parametrize("pods,expected_mem_mib", [
+        (8, 11 * 8 + 255),
+        (110, 11 * 110 + 255),
+        (234, 11 * 234 + 255),
+        (737, 11 * 737 + 255),
+    ])
+    def test_memory_per_pod(self, pods, expected_mem_mib):
+        r = kube_reserved(4000, pods)
+        assert r[MEMORY] == expected_mem_mib * MiB
+        assert r[EPHEMERAL_STORAGE] == 1 * GiB
+
+    def test_kubelet_kube_reserved_overrides(self):
+        kc = KubeletConfiguration(
+            kube_reserved=ResourceList({CPU: 123, MEMORY: 1 * GiB}))
+        r = kube_reserved(8000, 110, kc)
+        # lo.Assign semantics: the operator's values replace, per key
+        assert r[CPU] == 123
+        assert r[MEMORY] == 1 * GiB
+        assert r[EPHEMERAL_STORAGE] == 1 * GiB   # untouched key keeps default
+
+    def test_eviction_defaults(self):
+        r = eviction_threshold(8 * GiB, 100 * GiB)
+        assert r[MEMORY] == 100 * MiB
+        assert r[EPHEMERAL_STORAGE] == 10 * GiB   # 10% of disk
+
+    def test_eviction_override_below_default_wins(self):
+        """lo.Assign(overhead, override): the configured threshold REPLACES
+        the default even when smaller (types.go:370-399) — the old
+        max-with-default rule silently kept 100Mi."""
+        kc = KubeletConfiguration(
+            eviction_hard=ResourceList({MEMORY: 50 * MiB}))
+        r = eviction_threshold(8 * GiB, 100 * GiB, kc)
+        assert r[MEMORY] == 50 * MiB
+
+    def test_eviction_hard_soft_max(self):
+        """Across signals the reference takes MaxResources(hard, soft),
+        then that max replaces the default."""
+        kc = KubeletConfiguration(
+            eviction_hard=ResourceList({MEMORY: 200 * MiB}),
+            eviction_soft=ResourceList({MEMORY: 300 * MiB,
+                                        EPHEMERAL_STORAGE: 1 * GiB}))
+        r = eviction_threshold(8 * GiB, 100 * GiB, kc)
+        assert r[MEMORY] == 300 * MiB            # max(hard, soft)
+        assert r[EPHEMERAL_STORAGE] == 1 * GiB   # soft replaces 10% default
+
+    def test_allocatable_never_negative(self):
+        """Across the generated catalog grid, overhead must never exceed
+        capacity on any axis — the golden invariant of the overhead
+        pipeline."""
+        for it in generate_catalog(60):
+            for res, qty in it.allocatable.items():
+                assert qty >= 0, (it.name, res)
+            assert it.allocatable[CPU] < it.capacity[CPU]
+            assert it.allocatable[MEMORY] < it.capacity[MEMORY]
+
+
+@pytest.fixture
+def drift_stack():
+    cloud = FakeCloud()
+    cloud.subnets = [SubnetInfo("subnet-a", "zone-a", 100, {}),
+                     SubnetInfo("subnet-b", "zone-b", 100, {})]
+    cloud.security_groups = [SecurityGroupInfo("sg-1", "nodes", {})]
+    cloud.images = [ImageInfo("img-1", "standard", "amd64", 100.0)]
+    params = FakeParameterStore()
+    params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    vp = VersionProvider(FakeControlPlane(version="1.28"))
+    lts = LaunchTemplateProvider(
+        cloud, Resolver(ImageProvider(cloud, params, vp), "kc", "https://ep"),
+        "kc")
+    nc = NodeClass(status_security_groups=["sg-1"],
+                   status_subnets=["subnet-a", "subnet-b"],
+                   status_images=["img-1"],
+                   status_instance_profile="kc_profile")
+    provider = CloudProvider(cloud, generate_catalog(12), cluster_name="kc",
+                             node_classes={"default": nc},
+                             subnets=SubnetProvider(cloud),
+                             launch_templates=lts)
+    claim = provider.create(NodeClaim(nodepool="default"))
+    return cloud, provider, nc, claim
+
+
+class TestDriftMatrix:
+    """The full isNodeClassDrifted matrix (drift.go:42-67): static hash →
+    AMI → security groups → subnet, first hit wins."""
+
+    def test_clean_node_is_not_drifted(self, drift_stack):
+        _, provider, _, claim = drift_stack
+        assert provider.is_drifted(claim) is None
+
+    def test_static_hash_drift(self, drift_stack):
+        _, provider, nc, claim = drift_stack
+        claim.node_class_hash = "stale-hash"
+        assert provider.is_drifted(claim) == "NodeClassHashDrifted"
+
+    def test_ami_drift(self, drift_stack):
+        _, provider, nc, claim = drift_stack
+        nc.status_images = ["img-2"]
+        assert provider.is_drifted(claim) == "ImageDrifted"
+
+    def test_security_group_drift(self, drift_stack):
+        _, provider, nc, claim = drift_stack
+        nc.status_security_groups = ["sg-1", "sg-2"]
+        assert provider.is_drifted(claim) == "SecurityGroupDrifted"
+
+    def test_subnet_drift(self, drift_stack):
+        cloud, provider, nc, claim = drift_stack
+        nc.status_subnets = ["subnet-z"]
+        assert provider.is_drifted(claim) == "SubnetDrifted"
+
+    def test_precedence_static_beats_everything(self, drift_stack):
+        _, provider, nc, claim = drift_stack
+        claim.node_class_hash = "stale"
+        nc.status_images = ["img-2"]
+        nc.status_security_groups = ["sg-2"]
+        nc.status_subnets = ["subnet-z"]
+        assert provider.is_drifted(claim) == "NodeClassHashDrifted"
+
+    def test_precedence_ami_beats_sg_and_subnet(self, drift_stack):
+        _, provider, nc, claim = drift_stack
+        nc.status_images = ["img-2"]
+        nc.status_security_groups = ["sg-2"]
+        nc.status_subnets = ["subnet-z"]
+        assert provider.is_drifted(claim) == "ImageDrifted"
+
+    def test_precedence_sg_beats_subnet(self, drift_stack):
+        _, provider, nc, claim = drift_stack
+        nc.status_security_groups = ["sg-2"]
+        nc.status_subnets = ["subnet-z"]
+        assert provider.is_drifted(claim) == "SecurityGroupDrifted"
+
+    def test_gone_instance_skips_live_checks(self, drift_stack):
+        """A claim whose instance the cloud no longer knows can still be
+        judged on static/status grounds, never an exception."""
+        cloud, provider, nc, claim = drift_stack
+        cloud.terminate_instances([claim.provider_id])
+        claim.provider_id = "i-long-gone"
+        assert provider.is_drifted(claim) is None
+        nc.status_images = ["img-2"]
+        assert provider.is_drifted(claim) == "ImageDrifted"   # claim's AMI
+
+
+class TestLaunchTemplateCache:
+    """Cache eviction vs deliberate invalidation
+    (launchtemplate.go:137-146)."""
+
+    def _stack(self, clock):
+        cloud = FakeCloud()
+        cloud.subnets = [SubnetInfo("subnet-a", "zone-a", 100, {})]
+        cloud.security_groups = [SecurityGroupInfo("sg-1", "nodes", {})]
+        cloud.images = [ImageInfo("img-1", "standard", "amd64", 100.0)]
+        params = FakeParameterStore()
+        params.parameters = {
+            "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+        vp = VersionProvider(FakeControlPlane(version="1.28"))
+        lts = LaunchTemplateProvider(
+            cloud, Resolver(ImageProvider(cloud, params, vp), "kc",
+                            "https://ep"), "kc", clock=lambda: clock[0])
+        return cloud, lts
+
+    def _ensure(self, lts):
+        nc = NodeClass(status_instance_profile="p")
+        return nc, lts.ensure_all(nc, generate_catalog(4),
+                                  security_group_ids=("sg-1",),
+                                  instance_profile="p")
+
+    def test_invalidate_drops_cache_not_remote(self, ):
+        clock = [100.0]
+        cloud, lts = self._stack(clock)
+        nc, resolved = self._ensure(lts)
+        name = resolved[0].template.name
+        assert name in cloud.launch_templates
+        lts.invalidate(name)
+        # deliberate invalidation must NOT delete the stored template —
+        # other nodes may still launch from it (Invalidate:137-146 detaches
+        # the eviction callback for exactly this reason)
+        assert name in cloud.launch_templates
+        # next ensure adopts the existing template instead of failing
+        _, resolved2 = self._ensure(lts)
+        assert resolved2[0].template.name == name
+        assert cloud.calls["create_launch_template"] >= 1
+
+    def test_ttl_expiry_recreates_without_duplicate_error(self):
+        clock = [100.0]
+        cloud, lts = self._stack(clock)
+        _, resolved = self._ensure(lts)
+        creates = cloud.calls["create_launch_template"]
+        clock[0] += 10 * 3600          # TTL long gone
+        _, resolved2 = self._ensure(lts)
+        # content-addressed name is stable, the create raced AlreadyExists
+        # and adopted — no crash, no duplicate template
+        assert resolved2[0].template.name == resolved[0].template.name
+        assert len(cloud.launch_templates) == len(
+            {r.template.name for r in resolved2})
+
+    def test_hydrate_prewarms_cache(self):
+        clock = [100.0]
+        cloud, lts = self._stack(clock)
+        self._ensure(lts)
+        creates = cloud.calls["create_launch_template"]
+        # a fresh provider over the same cloud hydrates instead of creating
+        _, lts2 = self._stack(clock)[0], None
+        params = FakeParameterStore()
+        params.parameters = {
+            "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+        vp = VersionProvider(FakeControlPlane(version="1.28"))
+        fresh = LaunchTemplateProvider(
+            cloud, Resolver(ImageProvider(cloud, params, vp), "kc",
+                            "https://ep"), "kc", clock=lambda: clock[0])
+        assert fresh.hydrate_cache() >= 1
+        self._ensure(fresh)
+        assert cloud.calls["create_launch_template"] == creates
+
+
+class TestInterruptionParsingEdges:
+    """parser.go:54-80: unknown events become explicit noops, never
+    errors; per-kind shapes extract ids faithfully."""
+
+    def test_unknown_detail_type_is_noop(self):
+        e = parse_event('{"detail-type": "Totally New Event", "detail": {}}')
+        assert e.kind == NOOP and e.instance_ids == []
+
+    def test_malformed_json_is_noop(self):
+        assert parse_event("{not json").kind == NOOP
+        assert parse_event("").kind == NOOP
+
+    def test_null_detail_tolerated(self):
+        e = parse_event('{"detail-type": "Spot Instance Interruption '
+                        'Warning", "detail": null}')
+        assert e.kind == SPOT_INTERRUPTION
+        assert e.instance_ids == [""]
+
+    def test_scheduled_change_multi_entity(self):
+        body = make_event_body(SCHEDULED_CHANGE, ["i-1", "i-2", "i-3"])
+        e = parse_event(body)
+        assert e.kind == SCHEDULED_CHANGE
+        assert e.instance_ids == ["i-1", "i-2", "i-3"]
+
+    def test_scheduled_change_blank_entities_dropped(self):
+        e = parse_event('{"detail-type": "Scheduled Change", "detail": '
+                        '{"affected-entities": [{"entity-value": ""}, '
+                        '{"entity-value": "i-9"}, {}]}}')
+        assert e.instance_ids == ["i-9"]
+
+    def test_state_change_carries_state(self):
+        e = parse_event(make_event_body(STATE_CHANGE, ["i-1"],
+                                        state="shutting-down"))
+        assert e.kind == STATE_CHANGE
+        assert e.detail["state"] == "shutting-down"
+
+    def test_timestamp_passthrough(self):
+        e = parse_event(make_event_body(SPOT_INTERRUPTION, ["i-1"],
+                                        ts=1234.5))
+        assert e.start_time == 1234.5
