@@ -498,42 +498,52 @@ class CloudProvider:
                 current = nc.hash_annotation or static_hash(nc)
                 if claim.node_class_hash != current:
                     return "NodeClassHashDrifted"
-            instance = None
-            if claim.provider_id:
+            # the instance attributes drift checks read (boot AMI, subnet,
+            # launch template) are immutable post-launch, so the lookup
+            # runs ONCE per claim and memoizes on it — the disruption
+            # controller calls is_drifted for every candidate every tick,
+            # and N live describes per tick would be pure waste (review
+            # r5).  A failed lookup memoizes too (warn once, not per
+            # tick); deleting the attr forces a refresh.
+            meta = getattr(claim, "_drift_instance_meta", None)
+            if meta is None and claim.provider_id:
                 try:
-                    instance = self.cloud.get_instance(claim.provider_id)
+                    inst = self.cloud.get_instance(claim.provider_id)
+                    meta = (inst.image_id, inst.subnet_id,
+                            inst.launch_template)
+                    claim._drift_instance_meta = meta
                 except Exception as e:
-                    # live SG/subnet checks are skipped this pass and the
-                    # next reconcile retries — but never silently
-                    # (review r5: an unlogged skip is indistinguishable
-                    # from a no-drift verdict)
-                    log.warning(
-                        "drift check for %s: instance %s lookup failed "
-                        "(%s); static checks only this pass",
-                        claim.name, claim.provider_id, e)
-                    instance = None
+                    # failures are NOT memoized — the next reconcile
+                    # retries (a transient throttle must not disable
+                    # SG/subnet drift for the node's lifetime); only the
+                    # warning is deduped per claim
+                    if not getattr(claim, "_drift_lookup_warned", False):
+                        log.warning(
+                            "drift check for %s: instance %s lookup "
+                            "failed (%s); static checks only until the "
+                            "lookup succeeds", claim.name,
+                            claim.provider_id, e)
+                        claim._drift_lookup_warned = True
+                    meta = ("", "", "")
+            inst_image, inst_subnet, inst_lt = meta or ("", "", "")
             # AMI drift (isAMIDrifted): a newer image published under the
             # same selector resolves into status_images and drifts every
             # node booted from the old one; prefer the live instance's AMI
-            image = (instance.image_id if instance is not None
-                     and instance.image_id else claim.image_id)
+            image = inst_image or claim.image_id
             if image and nc.status_images and image not in nc.status_images:
                 return "ImageDrifted"
             # security-group drift (areSecurityGroupsDrifted): the launch
             # template the instance booted from carries its SG set — any
             # mismatch with the nodeclass's resolved set drifts
-            if (instance is not None and instance.launch_template
-                    and nc.status_security_groups):
-                lt = getattr(self.cloud, "launch_templates", {}).get(
-                    instance.launch_template)
+            if inst_lt and nc.status_security_groups:
+                lt = getattr(self.cloud, "launch_templates", {}).get(inst_lt)
                 if lt is not None and set(lt.security_group_ids) != \
                         set(nc.status_security_groups):
                     return "SecurityGroupDrifted"
             # subnet drift (isSubnetDrifted): instance's subnet no longer
             # among the nodeclass's resolved subnets
-            if (instance is not None and instance.subnet_id
-                    and nc.status_subnets
-                    and instance.subnet_id not in nc.status_subnets):
+            if (inst_subnet and nc.status_subnets
+                    and inst_subnet not in nc.status_subnets):
                 return "SubnetDrifted"
             if nc.status_zones and claim.zone not in nc.status_zones:
                 return "ZoneDrifted"

@@ -55,7 +55,10 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float),
         ]
@@ -88,7 +91,9 @@ def _ptr(a: np.ndarray, ctype):
 
 def ffd_pack_native(requests: np.ndarray, compat: np.ndarray,
                     class_ids: np.ndarray, caps: np.ndarray,
-                    alloc: np.ndarray, existing_used: Optional[np.ndarray],
+                    alloc: np.ndarray, price: np.ndarray,
+                    rank: np.ndarray,
+                    existing_used: Optional[np.ndarray],
                     O: int, E: int, K: int):
     """Raw slot-level pack (same contract as ops/ffd.ffd_pack_kernel).
     Returns (assignment P, slot_option K, slot_used K×R, n_open)."""
@@ -100,7 +105,15 @@ def ffd_pack_native(requests: np.ndarray, compat: np.ndarray,
     compat = np.ascontiguousarray(compat, np.uint8)
     class_ids = np.ascontiguousarray(class_ids, np.int32)
     caps = np.ascontiguousarray(caps, np.int32)
+    from ..ops.ffd import rem_in_class
+    rem = rem_in_class(class_ids)
     alloc = np.ascontiguousarray(alloc, np.float32)
+    price_a = np.zeros(alloc.shape[0], np.float32)
+    price_a[:min(len(price), len(price_a))] = np.nan_to_num(
+        np.asarray(price[:len(price_a)], np.float32), posinf=3.4e38)
+    rank_a = np.zeros(alloc.shape[0], np.int32)
+    rank_a[:min(len(rank), len(rank_a))] = np.asarray(
+        rank[:len(rank_a)], np.int32)
     if E:
         # None == existing nodes start empty (zero-fill like the JAX path)
         eu = (np.ascontiguousarray(existing_used, np.float32)
@@ -114,7 +127,9 @@ def ffd_pack_native(requests: np.ndarray, compat: np.ndarray,
         P, R, O, E, K,
         _ptr(requests, ctypes.c_float), _ptr(compat, ctypes.c_uint8),
         _ptr(class_ids, ctypes.c_int32), _ptr(caps, ctypes.c_int32),
-        _ptr(alloc, ctypes.c_float),
+        _ptr(rem, ctypes.c_int32),
+        _ptr(alloc, ctypes.c_float), _ptr(price_a, ctypes.c_float),
+        _ptr(rank_a, ctypes.c_int32),
         _ptr(eu, ctypes.c_float) if eu is not None
         else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)),
         _ptr(assignment, ctypes.c_int32), _ptr(slot_option, ctypes.c_int32),
@@ -152,7 +167,11 @@ def solve_ffd_native(problem, max_nodes: Optional[int] = None,
         return PackingResult(nodes=[], unschedulable=[int(i) for i in pod_idx],
                              existing_assignments={}, total_price=0.0)
     K = max(max_nodes if max_nodes is not None else P + E, E + 1)
+    price = problem.option_price
+    rank = (problem.option_rank if problem.option_rank is not None
+            else np.zeros(O, np.int32))
     assignment, slot_option, slot_used, _ = ffd_pack_native(
-        requests, compat, class_ids, row_caps, alloc, existing_used, O, E, K)
+        requests, compat, class_ids, row_caps, alloc, price, rank,
+        existing_used, O, E, K)
     return decode_assignment(problem, assignment, slot_option, slot_used,
                              pod_idx, compat, E, O, max_alternatives)

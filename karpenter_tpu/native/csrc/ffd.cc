@@ -10,6 +10,7 @@
 //
 // Build: g++ -O3 -shared -fPIC -o _libffd.so ffd.cc (see ../build.py).
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -23,7 +24,15 @@ extern "C" {
 //   compat     P×A  uint8   row × option feasibility, A = O + E
 //   class_ids  P    int32   contiguous per class (stable FFD sort)
 //   caps       P    int32   max pods of the row's class per node
+//   rem        P    int32   rows of the row's class still unplaced
+//                           (this row included) — the tail the new-node
+//                           score amortizes over
 //   alloc      A×R  float   allocatable per option (existing appended)
+//   price      A    float   hourly price per option (existing entries
+//                           ignored: they never open new nodes)
+//   rank       A    int32   pool-weight rank (0 = highest-weight pool);
+//                           new nodes come from the best-ranked pool
+//                           with any fitting option
 //   E existing nodes occupy slots [0, E) with option O+e and initial use
 //   existing_used E×R float (may be null when E == 0)
 //
@@ -36,7 +45,10 @@ extern "C" {
 int32_t ffd_pack(int32_t P, int32_t R, int32_t O, int32_t E, int32_t K,
                  const float* requests, const uint8_t* compat,
                  const int32_t* class_ids, const int32_t* caps,
-                 const float* alloc, const float* existing_used,
+                 const int32_t* rem,
+                 const float* alloc, const float* price,
+                 const int32_t* rank,
+                 const float* existing_used,
                  int32_t* assignment, int32_t* slot_option,
                  float* slot_used) {
   const int32_t A = O + E;
@@ -83,20 +95,45 @@ int32_t ffd_pack(int32_t P, int32_t R, int32_t O, int32_t E, int32_t K,
     }
 
     if (placed < 0 && n_open < K) {
-      // cheapest feasible new node == lowest option index (options arrive
-      // pre-sorted by pool rank then price, tensorize.build_options)
+      // new node: the option minimizing price × ceil(rem / m) — the
+      // tail-aware amortized cost of absorbing the class's unplaced rows,
+      // the same score the class-granular kernel uses.  A per-pod
+      // cheapest rule degenerates on catalogs with cheap tiny types
+      // (one pod per node at ~2× the blended optimum, review r5); ties
+      // break toward the lower index, which is pre-sorted by pool rank
+      // then price (tensorize.build_options).
+      int32_t best = -1;
+      float best_score = 0.0f;
+      int32_t best_r = INT32_MAX;   // pool-weight precedence: lowest rank
+      const float tail = (float)(rem[row] < 1 ? 1 : rem[row]);
       for (int32_t j = 0; j < O; ++j) {
         if (!crow[j] || cap < 1) continue;
+        if (rank[j] > best_r) continue;   // a better-ranked pool already fits
         const float* a = alloc + (size_t)j * R;
         bool fits = true;
-        for (int32_t r = 0; r < R; ++r)
+        float m = 3.4e38f;
+        for (int32_t r = 0; r < R; ++r) {
           if (req[r] > a[r]) { fits = false; break; }
+          if (req[r] > 0.0f) {
+            float mr = std::floor(a[r] / req[r]);
+            if (mr < m) m = mr;
+          }
+        }
         if (!fits) continue;
+        if (m < 1.0f) m = 1.0f;
+        if ((float)cap < m) m = (float)cap;
+        const float score = price[j] * std::ceil(tail / m);
+        if (rank[j] < best_r || best < 0 || score < best_score) {
+          best = j;
+          best_score = score;
+          best_r = rank[j];
+        }
+      }
+      if (best >= 0) {
         placed = n_open++;
-        slot_option[placed] = j;
+        slot_option[placed] = best;
         float* u = slot_used + (size_t)placed * R;
         for (int32_t r = 0; r < R; ++r) u[r] = req[r];
-        break;
       }
     }
 

@@ -340,24 +340,17 @@ class ControllerManager:
         for m in manifests:
             if not isinstance(m, dict):
                 raise BadRequest(f"bad manifest entry {m!r}: not an object")
-        applied = []
         with self._state_lock:
-            # two-phase so a 400 means NOTHING was applied: validate the
-            # whole batch first, register second (review r5: the old
-            # single pass left earlier manifests live behind a 400)
-            for m in manifests:
-                try:
-                    self.operator.validate(m)
-                except (ValueError, KeyError, TypeError,
-                        AttributeError) as e:
-                    raise BadRequest(
-                        f"admission failed for {m.get('kind')}/"
-                        f"{m.get('metadata', {}).get('name')}: {e}") from e
-            for m in manifests:
-                obj = self.operator.apply(m)
-                applied.append({"kind": m.get("kind"),
-                                "name": getattr(obj, "name", None)})
-        return {"applied": applied}
+            # two-phase inside Operator.apply_batch so a 400 means NOTHING
+            # was applied — admission runs for the whole batch (including
+            # intra-batch update-immutability) before any registration
+            try:
+                objs = self.operator.apply_batch(manifests)
+            except (ValueError, KeyError, TypeError, AttributeError) as e:
+                raise BadRequest(f"admission failed: {e}") from e
+        return {"applied": [{"kind": m.get("kind"),
+                             "name": getattr(o, "name", None)}
+                            for m, o in zip(manifests, objs)]}
 
     def list_request(self, kind: str) -> Dict:
         """GET /v1/nodepools | /v1/nodeclasses — the configured objects as

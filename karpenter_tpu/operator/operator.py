@@ -168,27 +168,43 @@ class Operator:
             log.info("hydrated %d nodes from cloud state", n)
         return n
 
-    def validate(self, manifest: Dict) -> None:
-        """Dry-run admission: everything `apply` would check — legacy
-        conversion, schema validation, defaulting-time parsing, update
-        immutability — WITHOUT registering anything.  Lets batch callers
-        (/v1/apply) reject the whole batch before any member takes
-        effect."""
+    def apply_batch(self, manifests) -> list:
+        """Atomic-intent batch apply: phase 1 runs EVERY manifest through
+        the same admission checks `apply` performs — legacy conversion,
+        schema validation, defaulting-time parsing, update immutability
+        against both live state AND earlier manifests in the batch (a
+        create followed by an immutable-field update in one batch must
+        fail up front) — phase 2 registers.  A phase-1 failure means
+        nothing was applied."""
         from ..api.admission import validate_manifest, validate_nodeclass_update
         from ..api.legacy import convert_manifest
-        from ..api.serialize import (nodeclass_from_manifest,
+        from ..api.serialize import (nodeclaim_from_manifest,
+                                     nodeclass_from_manifest,
                                      nodepool_from_manifest)
-        validate_manifest(manifest)
-        manifest = convert_manifest(manifest)
-        validate_manifest(manifest)
-        kind = manifest.get("kind")
-        if kind == "NodePool":
-            nodepool_from_manifest(manifest)
-        elif kind == "NodeClass":
-            nc = nodeclass_from_manifest(manifest)
-            original = self.node_classes.get(nc.name)
-            if original is not None:
-                validate_nodeclass_update(original, nc)
+        pending_nc: Dict[str, object] = {}
+        for manifest in manifests:
+            try:
+                validate_manifest(manifest)
+                m = convert_manifest(manifest)
+                validate_manifest(m)
+                kind = m.get("kind")
+                if kind == "NodePool":
+                    nodepool_from_manifest(m)
+                elif kind == "NodeClass":
+                    nc = nodeclass_from_manifest(m)
+                    original = pending_nc.get(nc.name) or \
+                        self.node_classes.get(nc.name)
+                    if original is not None:
+                        validate_nodeclass_update(original, nc)
+                    pending_nc[nc.name] = nc
+                elif kind == "NodeClaim":
+                    nodeclaim_from_manifest(m)
+            except (ValueError, KeyError, TypeError) as e:
+                raise ValueError(
+                    f"{manifest.get('kind')}/"
+                    f"{manifest.get('metadata', {}).get('name')}: {e}") \
+                    from e
+        return [self.apply(m) for m in manifests]
 
     def apply(self, manifest: Dict):
         """Admission-checked manifest ingestion — the kubectl-apply analog:

@@ -37,6 +37,7 @@ def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
                     valid: jax.Array,       # P bool (padding mask)
                     class_id: jax.Array,    # P int32 (rows of a class contiguous)
                     node_cap: jax.Array,    # P int32 max class pods per node
+                    rem_in_class: jax.Array,  # P int32 class rows left (incl.)
                     alloc: jax.Array,       # O×R full-capacity allocatable
                     price: jax.Array,       # O
                     rank: jax.Array,        # O int32 pool-weight rank
@@ -56,7 +57,7 @@ def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
 
     def step(carry, x):
         slot_option, slot_used, slot_cls, prev_cid, n_open = carry
-        req, comp, is_valid, cid, cap = x
+        req, comp, is_valid, cid, cap, tail = x
         slot_cls = jnp.where(cid == prev_cid, slot_cls, 0)
         opt = jnp.maximum(slot_option, 0)
         open_mask = slot_option >= 0
@@ -66,13 +67,25 @@ def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
         exist_k = jnp.argmax(fits)            # first-fit: lowest feasible slot
         any_fit = jnp.any(fits)
         # new node: highest-weight pool first (NodePool.spec.weight
-        # precedence), then cheapest able to hold the pod at full capacity;
-        # options are price-sorted with deterministic tie-breaks
-        # (instance.go:395-412), so argmin's first-match rule preserves them.
+        # precedence), then the option minimizing price × ceil(tail / m) —
+        # the amortized cost of absorbing the class's unplaced rows, the
+        # same tail-aware score the class-granular kernel uses.  A plain
+        # per-pod cheapest rule degenerates on catalogs with cheap tiny
+        # types (one pod per node at ~2× the blended optimum, review r5).
+        # Ties break toward the lower index, which is pre-sorted by pool
+        # rank then price (instance.go:395-412).
         new_ok = comp & jnp.all(req <= alloc, axis=-1) & jnp.isfinite(price)
         best_rank = jnp.min(jnp.where(new_ok, rank, _IBIG))
         new_ok_r = new_ok & (rank == best_rank)
-        new_opt = jnp.argmin(jnp.where(new_ok_r, price, jnp.inf))
+        reqpos = req > 0
+        safe_req = jnp.where(reqpos, req, 1.0)
+        m = jnp.min(jnp.where(reqpos[None, :],
+                              jnp.floor(alloc / safe_req[None, :]),
+                              jnp.float32(2**30)), axis=-1)
+        m = jnp.clip(m, 1.0, jnp.maximum(cap.astype(m.dtype), 1.0))
+        score = price * jnp.ceil(
+            jnp.maximum(tail, 1).astype(price.dtype) / m)
+        new_opt = jnp.argmin(jnp.where(new_ok_r, score, jnp.inf))
         can_new = jnp.any(new_ok) & (n_open < K)
         sched_exist = is_valid & any_fit
         sched_new = is_valid & ~any_fit & can_new
@@ -91,7 +104,7 @@ def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
     (slot_option, slot_used, _, _, n_open), assignment = jax.lax.scan(
         step, (init_option, init_used, jnp.zeros(K, jnp.int32),
                jnp.int32(-1), n_open0),
-        (requests, compat, valid, class_id, node_cap))
+        (requests, compat, valid, class_id, node_cap, rem_in_class))
     return assignment, slot_option, slot_used, n_open
 
 
@@ -122,6 +135,23 @@ class PackingResult:
 
 # below this many rows the native C++ packer beats a device kernel launch
 NATIVE_CUTOVER_ROWS = 256
+
+
+def rem_in_class(class_ids: np.ndarray) -> np.ndarray:
+    """Per row: rows of the row's class still unplaced (itself included) —
+    rows are class-contiguous, so this is count-from-the-back.  Feeds the
+    tail-aware new-node score in BOTH packers (JAX scan and the native
+    C++ core)."""
+    P = len(class_ids)
+    if P == 0:
+        return np.zeros(0, np.int32)
+    ends = np.nonzero(np.diff(class_ids, append=class_ids[-1] + 1))[0]
+    out = np.empty(P, np.int64)
+    start = 0
+    for e in ends:
+        out[start:e + 1] = np.arange(e + 1 - start, 0, -1)
+        start = e + 1
+    return out.astype(np.int32)
 
 
 def solve_ffd(problem: Problem,
@@ -200,6 +230,8 @@ def solve_ffd(problem: Problem,
     cid_p[:P] = class_ids
     cap_p = np.full(Ppad, 2**30, np.int32)
     cap_p[:P] = row_caps
+    rem_p = np.zeros(Ppad, np.int32)
+    rem_p[:P] = rem_in_class(class_ids)
     alloc_p = np.zeros((Opad, R), np.float32)
     alloc_p[:alloc.shape[0]] = alloc
     price_p = np.full(Opad, np.inf, np.float32)
@@ -215,7 +247,7 @@ def solve_ffd(problem: Problem,
 
     assignment, slot_option, slot_used, n_open = ffd_pack_kernel(
         jnp.asarray(req_p), jnp.asarray(comp_p), jnp.asarray(valid),
-        jnp.asarray(cid_p), jnp.asarray(cap_p),
+        jnp.asarray(cid_p), jnp.asarray(cap_p), jnp.asarray(rem_p),
         jnp.asarray(alloc_p), jnp.asarray(price_p), jnp.asarray(rank_p),
         jnp.asarray(init_option), jnp.asarray(init_used), K)
     assignment = np.asarray(assignment)[:P]

@@ -280,23 +280,28 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     O0 = problem.num_options
     if C0 < 2 or O0 == 0:
         return None
-    ok = _feasible_mask(problem)
-    if ok.any(axis=1).sum() < 2:
-        return None
     caps = (problem.class_node_cap if problem.class_node_cap is not None
             else np.full(C0, _BIG, np.int32))
 
-    # max_nodes is part of the key: a gate rejection under a tight launch
-    # cap must not disable the guide for the same pending set solved with
-    # a roomier budget (review r5)
+    # key over the RAW inputs — the feasibility mask is a deterministic
+    # (and, at 50k scale, ~150ms) function of them, so a cache hit skips
+    # recomputing it (it rides in the cached tuple).  max_nodes is part
+    # of the key: a gate rejection under a tight launch cap must not
+    # disable the guide for the same pending set solved with a roomier
+    # budget (review r5).
+    rank = (problem.option_rank if problem.option_rank is not None
+            else np.zeros(O0, np.int32))
     key = hashlib.blake2b(
         problem.class_requests.tobytes() + problem.class_counts.tobytes()
-        + np.packbits(ok).tobytes() + caps.tobytes()
+        + np.packbits(problem.class_compat).tobytes() + caps.tobytes()
         + problem.option_alloc.tobytes() + problem.option_price.tobytes()
-        + str(max_nodes).encode(),
+        + np.ascontiguousarray(rank).tobytes() + str(max_nodes).encode(),
         digest_size=16).digest()
     hit = _MIX_CACHE.get(key)
     if hit is None:
+        ok = _feasible_mask(problem)
+        if ok.any(axis=1).sum() < 2:
+            return None
         d_alloc, d_price, d_compat, group_of = _dedup_with_inverse(
             problem.option_alloc.astype(np.float64),
             problem.option_price.astype(np.float64), ok)
@@ -322,12 +327,12 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
         loadg = np.einsum("cj,cr->jr", y,
                           problem.class_requests.astype(np.float64))
         n_g = np.max(loadg / np.maximum(d_alloc, 1e-12), axis=1)
-        hit = [y, n_g, group_of, float(z), False]
+        hit = [y, n_g, group_of, float(z), ok, False]
         with _MIX_LOCK:
             while len(_MIX_CACHE) >= _MIX_CACHE_MAX:
                 _MIX_CACHE.pop(next(iter(_MIX_CACHE)), None)
             _MIX_CACHE[key] = hit
-    x, n_g, group_of, z_lp, rejected = hit
+    x, n_g, group_of, z_lp, ok, rejected = hit
     if rejected:
         return None
     # per-round launch-cap contract (review r5): the striper creates
@@ -403,52 +408,78 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     all_used = np.concatenate(node_used_parts) if node_used_parts else \
         np.zeros((0, R), np.int64)
     bulk_oi = all_oi[occupied].tolist()
-    bulk_used = list(all_used[occupied])
     bulk_pods = [pod_ids[s:e].tolist() for s, e in zip(starts, ends)]
-    bulk_cls = [np.unique(cls_ids[s:e]).tolist()
-                for s, e in zip(starts, ends)]
+    # duplicates are fine downstream (joint compat ANDs idempotently), so
+    # skip the ~per-node np.unique
+    bulk_cls = [cls_ids[s:e].tolist() for s, e in zip(starts, ends)]
 
     if not bulk_oi:
         return None
 
-    # ---- remainder: fractional leftovers, demotions, capped classes ----
+    # ---- cross-group tuck: demoted pods into ANY bulk node with room ----
+    # Striping strands slivers per node (≈1-2% of bulk capacity) while
+    # demoting the pods that no longer fit their OWN group; across groups
+    # those slivers add up to whole node-equivalents.  One host-side
+    # least-loaded pass over the entire fleet (compat-checked against each
+    # node's option) re-places most demotions for free — measured 12%→
+    # remainder drop to a few % on 50k-burst — and lets the remainder
+    # solve run WITHOUT existing columns, keeping the fresh kernel's
+    # compiled shapes.  Hostname-capped classes stay out (their per-node
+    # caps need the kernel).
     rem = problem.class_counts.astype(np.int64) - consumed
+    alloc_int = problem.option_alloc.astype(np.int64)
+    used_mat = all_used[occupied].astype(np.int64)
+    node_oi_arr = np.asarray(bulk_oi, np.int64)
+    free_mat = alloc_int[node_oi_arr] - used_mat
+    inv_node_alloc = 1.0 / np.maximum(alloc_int[node_oi_arr], 1)
+    tuck_order = np.argsort(
+        -(reqs_int / np.maximum(alloc_int.mean(axis=0), 1)).max(axis=1))
+    for c in tuck_order:
+        if rem[c] <= 0:
+            continue
+        rc = reqs_int[c]
+        node_ok = ok[c][node_oi_arr]
+        # hostname-capped classes tuck too: striped bulk nodes host none
+        # of their pods, so a fresh per-node counter enforces the cap
+        # exactly (review r5: skipping them forced fresh launches for
+        # pods the fleet's slivers could legally hold)
+        placed_c = np.zeros(len(node_oi_arr), np.int64)
+        cap_c = int(caps[c])
+        while rem[c] > 0:
+            fits = node_ok & (free_mat >= rc[None, :]).all(axis=1) & \
+                (placed_c < cap_c)
+            n_fit = int(fits.sum())
+            if n_fit == 0:
+                break
+            take = min(int(rem[c]), n_fit)
+            if take < n_fit:
+                load = np.max(used_mat * inv_node_alloc, axis=1)
+                load[~fits] = np.inf
+                sel = np.argpartition(load, take - 1)[:take]
+            else:
+                sel = np.nonzero(fits)[0]
+            mem = members_arr[c]
+            for i in sel:
+                bulk_pods[i].append(int(mem[ptr[c]]))
+                ptr[c] += 1
+                if c not in bulk_cls[i]:
+                    bulk_cls[i].append(int(c))
+            used_mat[sel] += rc
+            free_mat[sel] -= rc
+            placed_c[sel] += 1
+            consumed[c] += take
+            rem[c] -= take
+
+    # ---- remainder: what even the tuck couldn't place, capped classes ----
     rem_cls = np.nonzero(rem > 0)[0]
     sub_res = None
-    ex_map: list = []
     if len(rem_cls):
         sub = _subproblem(problem, rem_cls, rem[rem_cls], ptr)
-        # existing columns: only bulk nodes with meaningful free space —
-        # most striped nodes are ~full, and a narrow column set keeps the
-        # kernel's option axis (and its host→device payload) small
-        alloc_int = problem.option_alloc.astype(np.int64)
-        free = np.asarray([alloc_int[oi] - u
-                           for oi, u in zip(bulk_oi, bulk_used)])
-        min_req = reqs_int[rem_cls].min(axis=0)
-        roomy = np.nonzero((free >= min_req[None, :]).all(axis=1))[0]
-        if len(roomy) > 128:
-            # cap the existing-column count: each column widens the
-            # kernel's option axis (compat width, padded shapes, compile
-            # variants); the remainder is small, so the 128 roomiest
-            # nodes are plenty
-            norm = np.maximum(alloc_int[[bulk_oi[i] for i in roomy]], 1)
-            room = (free[roomy] / norm).min(axis=1)
-            roomy = roomy[np.argsort(-room)[:128]]
-        ex_alloc = ex_used = ex_compat = None
-        if len(roomy):
-            ex_map = roomy.tolist()
-            ex_alloc = np.asarray([problem.option_alloc[bulk_oi[i]]
-                                   for i in roomy])
-            ex_used = np.asarray([bulk_used[i] for i in roomy],
-                                 dtype=np.float64)
-            ex_compat = problem.class_compat[np.ix_(
-                rem_cls, [bulk_oi[i] for i in roomy])]
-        # remainder opens count against the same per-round budget the
-        # striped fleet already consumed (existing columns occupy K slots
-        # too, so they ride on top of the remaining allowance).  A fully
-        # consumed budget removes the catalog outright — remainder pods
-        # may still tuck into striped free space, but nothing launches
-        # (review r5: the old max(1, …) floor leaked one extra node).
+        # fresh-only solve: the tuck already consumed the fleet's usable
+        # slivers, so existing columns would add kernel shape variants for
+        # nothing.  A fully consumed launch budget removes the catalog
+        # outright — then these pods come back unschedulable for the next
+        # round (review r5: the old max(1, …) floor leaked an extra node).
         budget = max_nodes - len(bulk_oi)
         if budget <= 0:
             sub.options = []
@@ -462,11 +493,7 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
                 sub.option_captype = sub.option_captype[:0]
             sub.class_compat = sub.class_compat[:, :0]
             budget = 0
-        sub_max = budget + len(ex_map)
-        sub_res = solve_classpack(sub, max_nodes=max(sub_max, 1),
-                                  existing_alloc=ex_alloc,
-                                  existing_used=ex_used,
-                                  existing_compat=ex_compat,
+        sub_res = solve_classpack(sub, max_nodes=max(budget, 1),
                                   decode=True, guide=None,
                                   max_alternatives=max_alternatives)
 
@@ -478,17 +505,6 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
         unschedulable = sub_res.unschedulable
         new_nodes = sub_res.nodes
         total += sub_res.total_price
-        pod_class = {}
-        for c in rem_cls:
-            for p in members_arr[c][ptr[c]:]:
-                pod_class[int(p)] = int(c)
-        for p, e in sub_res.existing_assignments.items():
-            i = ex_map[e]
-            bulk_pods[i].append(p)
-            c = pod_class[p]
-            if c not in bulk_cls[i]:
-                bulk_cls[i].append(c)
-            bulk_used[i] = bulk_used[i] + reqs_int[c]
 
     # acceptance gate: when integrality friction blows the result past
     # the guide's design envelope (tiny fleets, where one node of ceil
@@ -513,16 +529,15 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
         # already materialized) instead of permanently rejecting the key
         if (probe_unsched, probe_total) > (len(greedy.unschedulable),
                                            greedy.total_price):
-            hit[4] = True
+            hit[5] = True
             return None
 
-    compat_bits = np.packbits(problem.class_compat, axis=1)
-    jcb_list = [compat_bits[cl[0]] if len(cl) == 1 else
-                np.bitwise_and.reduce(compat_bits[cl], axis=0)
-                for cl in bulk_cls]
-    used_mat = np.asarray(bulk_used, np.int64)
-    resolved = resolve_alternatives(problem, bulk_oi, jcb_list, used_mat,
-                                    max_alternatives)
+    # memo keys are the nodes' class SETS — joint-compat bits are only
+    # computed for memo misses inside resolve_alternatives (a fleet-wide
+    # AND costs ~100ms at 50k; the distinct keys are a few hundred)
+    cls_keys = [tuple(sorted(set(cl))) for cl in bulk_cls]
+    resolved = resolve_alternatives(problem, bulk_oi, None, used_mat,
+                                    max_alternatives, cls_keys=cls_keys)
     nodes = []
     for i, oi in enumerate(bulk_oi):
         alts, used_rl = resolved[i]
