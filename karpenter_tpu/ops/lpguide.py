@@ -438,7 +438,10 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
         if rem[c] <= 0:
             continue
         rc = reqs_int[c]
-        node_ok = ok[c][node_oi_arr]
+        # RAW compat, not the rank-restricted mask: pool-weight precedence
+        # governs what to LAUNCH, never what already-bought capacity may
+        # host (same rule as the kernel's existing columns; review r5)
+        node_ok = problem.class_compat[c][node_oi_arr]
         # hostname-capped classes tuck too: striped bulk nodes host none
         # of their pods, so a fresh per-node counter enforces the cap
         # exactly (review r5: skipping them forced fresh launches for

@@ -306,3 +306,25 @@ class TestInterruptionParsingEdges:
         e = parse_event(make_event_body(SPOT_INTERRUPTION, ["i-1"],
                                         ts=1234.5))
         assert e.start_time == 1234.5
+
+
+def test_gendocs_covers_every_type(tmp_path):
+    """tools/gendocs.py emits a section per catalog type with labels,
+    resources, and offerings (the reference's instance-types page
+    generator, hack/docs/instancetypes_gen_docs.go)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "it.md"
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "gendocs.py"),
+         "--types", "6", "--out", str(out)],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert r.returncode == 0, r.stderr[-500:]
+    text = out.read_text()
+    from karpenter_tpu.catalog.generate import generate_catalog
+    for it in generate_catalog(6):
+        assert f"### `{it.name}`" in text
+    assert "node.kubernetes.io/instance-type" in text
+    assert "| Capacity type | $/hour |" in text
