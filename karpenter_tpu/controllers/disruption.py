@@ -138,7 +138,8 @@ class DisruptionController:
                  max_candidates: int = 100,
                  terminator: Optional["TerminationController"] = None,
                  spot_min_flexibility: int = SPOT_TO_SPOT_MIN_ALTERNATIVES,
-                 recorder=None):
+                 recorder=None,
+                 lp_guide: bool = True):
         from ..utils.events import Recorder
         self.provider = provider
         self.cluster = cluster
@@ -150,6 +151,7 @@ class DisruptionController:
         self.drift_enabled = drift_enabled
         self.max_candidates = max_candidates
         self.spot_min_flexibility = spot_min_flexibility
+        self.lp_guide = lp_guide
         self._empty_since: Dict[str, float] = {}  # node → first seen empty
 
     # ------------------------------------------------------------------
@@ -286,7 +288,11 @@ class DisruptionController:
             existing_alloc=alloc if len(node_list) else None,
             existing_used=used if len(node_list) else None,
             existing_compat=compat if len(node_list) else None,
-            decode=decode)
+            decode=decode,
+            # the LPGuide gate covers THIS path too: a fresh replacement
+            # solve (all candidates excluded, no survivors) would
+            # otherwise run the guide despite the escape hatch
+            guide="lp" if self.lp_guide else None)
         if decode:
             # intra-batch anti-affinity/spread the masks can't express: a
             # violated placement disqualifies the whole action (the

@@ -16,6 +16,7 @@ launches and ICE fallback (/root/reference/pkg/providers/instance/instance.go:88
 
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from dataclasses import dataclass, field
@@ -99,13 +100,19 @@ class Provisioner:
                  nodepools,
                  clock: Callable[[], float] = time.time,
                  max_nodes_per_round: int = 2048,
-                 solver: str = "auto"):
+                 solver: str = "auto",
+                 lp_guide: bool = True):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
         self.clock = clock
         self.max_nodes_per_round = max_nodes_per_round
         self.solver = solver
+        # the LPGuide feature gate: False routes classpack solves straight
+        # to the greedy (guide=None) — the operational escape hatch
+        self.lp_guide = lp_guide
+        self._classpack = (solve_classpack if lp_guide else
+                           functools.partial(solve_classpack, guide=None))
 
     def _pick_solver(self, problem: Problem, n_existing: int = 0):
         """The flagship class-granular kernel IS the provisioning hot path —
@@ -114,11 +121,11 @@ class Provisioner:
         pod-granular solve, whose native backend finishes before a device
         kernel launch would (ops/ffd.py backend="auto")."""
         if self.solver == "classpack":
-            return solve_classpack
+            return self._classpack
         if self.solver == "ffd":
             return solve_ffd
         rows = int(problem.class_counts.sum()) + n_existing
-        return solve_ffd if rows <= NATIVE_CUTOVER_ROWS else solve_classpack
+        return solve_ffd if rows <= NATIVE_CUTOVER_ROWS else self._classpack
 
     def _pools_within_limits(self) -> List[NodePool]:
         usage = self.cluster.nodepool_usage()

@@ -292,7 +292,9 @@ def build_controllers(op: Operator) -> Dict[str, object]:
     /root/reference/pkg/controllers/controllers.go:45-65 + core registration
     in cmd/controller/main.go:47-70). Interruption registers only when a
     queue is configured; pricing refresh only outside isolated networks."""
-    provisioner = Provisioner(op.cloud_provider, op.cluster, op.nodepools)
+    provisioner = Provisioner(
+        op.cloud_provider, op.cluster, op.nodepools,
+        lp_guide=op.options.gate("LPGuide"))
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {
@@ -302,6 +304,7 @@ def build_controllers(op: Operator) -> Dict[str, object]:
             op.cloud_provider, op.cluster, op.nodepools,
             terminator=terminator, clock=op.clock,
             drift_enabled=op.options.gate("Drift"),
+            lp_guide=op.options.gate("LPGuide"),
             recorder=op.recorder),
         "lifecycle": LifecycleController(
             op.cloud_provider, op.cluster, nodepools=op.nodepools,

@@ -46,8 +46,12 @@ class Options:
     health_port: int = DEFAULT_HEALTH_PORT
     leader_elect: bool = False
     enable_profiling: bool = False   # settings.md:23 --enable-profiling
+    # LPGuide: the relaxed-LP fleet-mix guide in front of the pack kernel
+    # (ops/lpguide.py) — on by default, an operational escape hatch back to
+    # the pure greedy (--feature-gates LPGuide=false) like the reference's
+    # Drift gate (settings.md feature-gates)
     feature_gates: Dict[str, bool] = field(
-        default_factory=lambda: {"Drift": True})
+        default_factory=lambda: {"Drift": True, "LPGuide": True})
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod

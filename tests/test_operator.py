@@ -376,6 +376,21 @@ class TestControllerManager:
         finally:
             mgr.stop()
 
+    def test_lpguide_feature_gate_plumbs_to_provisioner(self):
+        """--feature-gates LPGuide=false is the escape hatch back to the
+        pure greedy packer; default is on."""
+        clock = [100.0]
+        op = self._operator(clock)
+        ctrls = build_controllers(op)
+        assert ctrls["provisioning"].lp_guide is True
+        from karpenter_tpu.operator.options import Options
+        opts = Options.from_args(["--cluster-name", "t",
+                                  "--feature-gates", "LPGuide=false"])
+        assert opts.feature_gates["LPGuide"] is False
+        op2 = self._operator(clock)
+        op2.options.feature_gates["LPGuide"] = False
+        assert build_controllers(op2)["provisioning"].lp_guide is False
+
     def test_leader_election_gates_ticks(self, tmp_path):
         clock = [100.0]
         lease = str(tmp_path / "lease.json")
