@@ -210,3 +210,52 @@ class TestSolverCaches:
         assert len(classpack._PODSIDE_CACHE) <= classpack._PODSIDE_CACHE_MAX
         assert len(classpack._ALT_MEMO) <= classpack._ALT_MEMO_MAX_CATALOGS
         assert len(classpack._CATALOG_CACHE) <= classpack._CATALOG_CACHE_MAX
+
+
+class TestGuidedMixCacheConcurrency:
+    def test_concurrent_guided_solves_share_mix_cache_safely(self):
+        """Hammer 4 distinct guided workloads over one catalog from 16
+        threads: the LP-mix cache (check-then-insert under its lock,
+        bounded) must serve every thread a plan whose pod assignment is
+        exactly a partition of the batch, with identical cost per
+        workload regardless of interleaving, and the guided path must
+        actually ENGAGE (cache grows by one key per workload)."""
+        from helpers import cpu_pod, make_type
+        from karpenter_tpu.api.objects import NodePool
+        from karpenter_tpu.ops import lpguide
+        from karpenter_tpu.ops.classpack import solve_classpack
+        from karpenter_tpu.ops.tensorize import tensorize
+
+        catalog = [make_type("pair", 10, 10, 1.00, zones=("zone-a",)),
+                   make_type("cpu-sp", 10, 2, 0.75, zones=("zone-a",)),
+                   make_type("mem-sp", 2, 10, 0.75, zones=("zone-a",))]
+
+        def workload(v):
+            n = 120 + 20 * v
+            return ([cpu_pod(cpu_m=4200, mem_mib=300) for _ in range(n // 2)]
+                    + [cpu_pod(cpu_m=300, mem_mib=3584)
+                       for _ in range(n // 2)])
+
+        probs = [tensorize(workload(v), catalog, [NodePool()])
+                 for v in range(4)]
+        base_entries = len(lpguide._MIX_CACHE)
+        # warm compiles single-threaded so threads only race the caches
+        baseline = {}
+        for v, p in enumerate(probs):
+            baseline[v] = solve_classpack(p).total_price
+        # the guide must actually be engaging, or the test is vacuous
+        assert len(lpguide._MIX_CACHE) >= min(base_entries + 4,
+                                              lpguide._MIX_CACHE_MAX)
+
+        def body(t, i):
+            v = (t + i) % 4
+            r = solve_classpack(probs[v])
+            # exact partition: every pod exactly once, none invented
+            seen = sorted(p for nd in r.nodes for p in nd.pod_indices)
+            seen += sorted(r.unschedulable)
+            assert sorted(seen) == list(
+                range(int(probs[v].class_counts.sum())))
+            assert r.total_price == baseline[v]
+
+        hammer(body, iters=8)
+        assert len(lpguide._MIX_CACHE) <= lpguide._MIX_CACHE_MAX
