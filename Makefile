@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test benchmark benchmark-interruption deflake native clean help
+.PHONY: test scale-test benchmark bench-smoke benchmark-interruption deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -15,6 +15,9 @@ scale-test: ## The in-process scale suite only
 
 benchmark: ## Headline solve benchmark (one JSON line on stdout)
 	python bench.py
+
+bench-smoke: ## Fast bench sanity pass: 1k-homogeneous config only
+	python bench.py --smoke
 
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py

@@ -101,7 +101,8 @@ class Provisioner:
                  clock: Callable[[], float] = time.time,
                  max_nodes_per_round: int = 2048,
                  solver: str = "auto",
-                 lp_guide: bool = True):
+                 lp_guide: bool = True,
+                 refinery=None):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
@@ -109,10 +110,21 @@ class Provisioner:
         self.max_nodes_per_round = max_nodes_per_round
         self.solver = solver
         # the LPGuide feature gate: False routes classpack solves straight
-        # to the greedy (guide=None) — the operational escape hatch
+        # to the greedy (guide=None) — the operational escape hatch.
+        # With a refinery (LPRefinery gate), guide misses never block the
+        # tick: cold solves ship greedy/stale plans and the colgen LP
+        # refines in the refinery's worker, upgrading the next tick
+        # (ops/refinery.py); the manager consumes refinery.take_upgrade()
+        # for the one-shot early re-solve.
         self.lp_guide = lp_guide
-        self._classpack = (solve_classpack if lp_guide else
-                           functools.partial(solve_classpack, guide=None))
+        self.refinery = refinery if lp_guide else None
+        if not lp_guide:
+            self._classpack = functools.partial(solve_classpack, guide=None)
+        elif self.refinery is not None:
+            self._classpack = functools.partial(solve_classpack,
+                                                refinery=self.refinery)
+        else:
+            self._classpack = solve_classpack
 
     def _pick_solver(self, problem: Problem, n_existing: int = 0):
         """The flagship class-granular kernel IS the provisioning hot path —

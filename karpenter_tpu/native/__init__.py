@@ -108,6 +108,16 @@ def ffd_pack_native(requests: np.ndarray, compat: np.ndarray,
     from ..ops.ffd import rem_in_class
     rem = rem_in_class(class_ids)
     alloc = np.ascontiguousarray(alloc, np.float32)
+    # the JAX kernel never opens a node on a non-finite-priced option
+    # (ops/ffd.py new_ok gates on isfinite); the float32 clamp below only
+    # demotes such options to "most expensive", which still opens them
+    # when nothing else fits — mask their compat columns instead.  Only
+    # the first O columns are options; pre-opened slots (>= O) keep their
+    # compatibility regardless of price.
+    nonfinite = ~np.isfinite(np.asarray(price[:O], np.float64))
+    if nonfinite.any():
+        compat = compat.copy()
+        compat[:, :O][:, nonfinite] = 0
     price_a = np.zeros(alloc.shape[0], np.float32)
     price_a[:min(len(price), len(price_a))] = np.nan_to_num(
         np.asarray(price[:len(price_a)], np.float32), posinf=3.4e38)

@@ -215,8 +215,18 @@ class ControllerManager:
         results: Dict[str, object] = {}
         prov = self.controllers.get("provisioning")
         if prov is not None:
-            self.batch_window.observe(len(self.operator.cluster.pending_pods()))
-            if self.batch_window.ripe():
+            pending = len(self.operator.cluster.pending_pods())
+            self.batch_window.observe(pending)
+            ripe = self.batch_window.ripe()
+            # one-shot early re-solve: the refinery just landed a refined
+            # mix that beats the greedy plan by more than its upgrade
+            # threshold — solving still-pending pods now captures the
+            # saving instead of waiting out the batch window
+            refinery = getattr(prov, "refinery", None)
+            if not ripe and pending and refinery is not None \
+                    and refinery.take_upgrade():
+                ripe = True
+            if ripe:
                 results["provisioning"] = prov.provision()
                 self.batch_window.reset()
         for e in self._entries:
@@ -250,6 +260,10 @@ class ControllerManager:
         self._stop.set()
         if self._http is not None:
             self._http.shutdown()
+        refinery = getattr(self.controllers.get("provisioning"), "refinery",
+                           None)
+        if refinery is not None:
+            refinery.stop()
 
     # ------------------------------------------------------------------
     def solve_request(self, payload: Dict) -> Dict:

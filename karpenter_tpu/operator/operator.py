@@ -174,14 +174,16 @@ class Operator:
         schema validation, defaulting-time parsing, update immutability
         against both live state AND earlier manifests in the batch (a
         create followed by an immutable-field update in one batch must
-        fail up front) — phase 2 registers.  A phase-1 failure means
-        nothing was applied."""
+        fail up front) — phase 2 registers the objects phase 1 already
+        admitted, so admission runs exactly once per manifest.  A phase-1
+        failure means nothing was applied."""
         from ..api.admission import validate_manifest, validate_nodeclass_update
         from ..api.legacy import convert_manifest
         from ..api.serialize import (nodeclaim_from_manifest,
                                      nodeclass_from_manifest,
                                      nodepool_from_manifest)
         pending_nc: Dict[str, object] = {}
+        staged: List = []
         for manifest in manifests:
             try:
                 validate_manifest(manifest)
@@ -189,7 +191,7 @@ class Operator:
                 validate_manifest(m)
                 kind = m.get("kind")
                 if kind == "NodePool":
-                    nodepool_from_manifest(m)
+                    staged.append((kind, nodepool_from_manifest(m)))
                 elif kind == "NodeClass":
                     nc = nodeclass_from_manifest(m)
                     original = pending_nc.get(nc.name) or \
@@ -197,14 +199,17 @@ class Operator:
                     if original is not None:
                         validate_nodeclass_update(original, nc)
                     pending_nc[nc.name] = nc
+                    staged.append((kind, nc))
                 elif kind == "NodeClaim":
-                    nodeclaim_from_manifest(m)
+                    staged.append((kind, nodeclaim_from_manifest(m)))
+                else:
+                    raise ValueError(f"cannot apply kind {kind!r}")
             except (ValueError, KeyError, TypeError) as e:
                 raise ValueError(
                     f"{manifest.get('kind')}/"
                     f"{manifest.get('metadata', {}).get('name')}: {e}") \
                     from e
-        return [self.apply(m) for m in manifests]
+        return [self._register(kind, obj) for kind, obj in staged]
 
     def apply(self, manifest: Dict):
         """Admission-checked manifest ingestion — the kubectl-apply analog:
@@ -225,45 +230,58 @@ class Operator:
         validate_manifest(manifest)
         kind = manifest.get("kind")
         if kind == "NodePool":
-            pool = nodepool_from_manifest(manifest)  # defaults + validates
-            self.nodepools[pool.name] = pool
-            log.info("applied NodePool %s", pool.name)
-            return pool
-        if kind == "NodeClass":
-            nc = nodeclass_from_manifest(manifest)   # defaults + validates
-            original = self.node_classes.get(nc.name)
+            obj = nodepool_from_manifest(manifest)   # defaults + validates
+        elif kind == "NodeClass":
+            obj = nodeclass_from_manifest(manifest)  # defaults + validates
+            original = self.node_classes.get(obj.name)
             if original is not None:
-                validate_nodeclass_update(original, nc)
-            self.node_classes[nc.name] = nc
-            log.info("applied NodeClass %s", nc.name)
-            return nc
-        if kind == "NodeClaim":
-            # normally machine-created; applying one (e.g. a migrated legacy
-            # Machine record) registers it into cluster state. A claim with
-            # a live instance goes through the same promotion as restart
-            # hydration so its capacity is schedulable and disruptable —
-            # not just GC-protected.
+                validate_nodeclass_update(original, obj)
+        elif kind == "NodeClaim":
             from ..api.serialize import nodeclaim_from_manifest
-            claim = nodeclaim_from_manifest(manifest)
-            if claim.provider_id and not self.cluster.claim_for_provider_id(
-                    claim.provider_id):
-                it = next((t for t in self.catalog
-                           if t.name == claim.instance_type), None)
-                if it is not None:
-                    it = effective_instance_type(
-                        it, self.nodepools.get(claim.nodepool),
-                        self.node_classes.get(claim.node_class_ref))
-                allocatable = it.allocatable if it else claim.requests
-                claim.created_at = claim.created_at or claim.launched_at
-                node = self.cluster.register_nodeclaim(
-                    claim, allocatable, it.capacity if it else None,
-                    rehydrate=True)
-                node.created_at = claim.launched_at or node.created_at
-            else:
-                self.cluster.nodeclaims[claim.name] = claim
-            log.info("applied NodeClaim %s", claim.name)
-            return claim
-        raise ValueError(f"cannot apply kind {kind!r}")
+            obj = nodeclaim_from_manifest(manifest)
+        else:
+            raise ValueError(f"cannot apply kind {kind!r}")
+        return self._register(kind, obj)
+
+    def _register(self, kind: str, obj):
+        """Admission phase 2: record an already-validated object in live
+        controller state.  `apply` and `apply_batch` both end here —
+        batch registration must not re-run admission (a NodeClass update
+        re-validated at registration time would check against its own
+        phase-1 sibling instead of pre-batch state, and would pay the
+        schema walk twice)."""
+        if kind == "NodePool":
+            self.nodepools[obj.name] = obj
+            log.info("applied NodePool %s", obj.name)
+            return obj
+        if kind == "NodeClass":
+            self.node_classes[obj.name] = obj
+            log.info("applied NodeClass %s", obj.name)
+            return obj
+        # NodeClaim: normally machine-created; applying one (e.g. a migrated
+        # legacy Machine record) registers it into cluster state. A claim
+        # with a live instance goes through the same promotion as restart
+        # hydration so its capacity is schedulable and disruptable — not
+        # just GC-protected.
+        claim = obj
+        if claim.provider_id and not self.cluster.claim_for_provider_id(
+                claim.provider_id):
+            it = next((t for t in self.catalog
+                       if t.name == claim.instance_type), None)
+            if it is not None:
+                it = effective_instance_type(
+                    it, self.nodepools.get(claim.nodepool),
+                    self.node_classes.get(claim.node_class_ref))
+            allocatable = it.allocatable if it else claim.requests
+            claim.created_at = claim.created_at or claim.launched_at
+            node = self.cluster.register_nodeclaim(
+                claim, allocatable, it.capacity if it else None,
+                rehydrate=True)
+            node.created_at = claim.launched_at or node.created_at
+        else:
+            self.cluster.nodeclaims[claim.name] = claim
+        log.info("applied NodeClaim %s", claim.name)
+        return claim
 
     def delete(self, kind: str, name: str) -> bool:
         """Deregister a NodePool, or finalize + deregister a NodeClass
@@ -291,10 +309,18 @@ def build_controllers(op: Operator) -> Dict[str, object]:
     """Assemble the controller set (controllers.NewControllers
     /root/reference/pkg/controllers/controllers.go:45-65 + core registration
     in cmd/controller/main.go:47-70). Interruption registers only when a
-    queue is configured; pricing refresh only outside isolated networks."""
+    queue is configured; pricing refresh only outside isolated networks.
+    With both LPGuide and LPRefinery gates on, the provisioner gets a
+    GuideRefinery so cold guide solves never block the tick — the colgen
+    LP refines in a background worker and upgrades the next tick."""
+    refinery = None
+    if op.options.gate("LPGuide") and op.options.gate("LPRefinery"):
+        from ..ops.refinery import GuideRefinery
+        refinery = GuideRefinery(clock=op.clock)
     provisioner = Provisioner(
         op.cloud_provider, op.cluster, op.nodepools,
-        lp_guide=op.options.gate("LPGuide"))
+        lp_guide=op.options.gate("LPGuide"),
+        refinery=refinery)
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {

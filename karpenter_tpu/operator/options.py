@@ -49,9 +49,16 @@ class Options:
     # LPGuide: the relaxed-LP fleet-mix guide in front of the pack kernel
     # (ops/lpguide.py) — on by default, an operational escape hatch back to
     # the pure greedy (--feature-gates LPGuide=false) like the reference's
-    # Drift gate (settings.md feature-gates)
+    # Drift gate (settings.md feature-gates).
+    # LPRefinery: run the guide's column generation in a background worker
+    # (ops/refinery.py) so no provisioning tick blocks on a cold LP — cold
+    # ticks ship the greedy (or a bounded-staleness rescaled) plan and the
+    # refined mix upgrades the next tick.  Off by default while it
+    # graduates; enable with --lp-refinery or --feature-gates
+    # LPRefinery=true (requires LPGuide).
     feature_gates: Dict[str, bool] = field(
-        default_factory=lambda: {"Drift": True, "LPGuide": True})
+        default_factory=lambda: {"Drift": True, "LPGuide": True,
+                                 "LPRefinery": False})
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
@@ -86,6 +93,10 @@ class Options:
                        default=env.get("leader_elect", False))
         p.add_argument("--enable-profiling", action="store_true",
                        default=env.get("enable_profiling", False))
+        p.add_argument("--lp-refinery", action="store_true", default=False,
+                       help="refine LP guides in a background worker so "
+                            "ticks never block on column generation "
+                            "(shorthand for --feature-gates LPRefinery=true)")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -108,6 +119,8 @@ class Options:
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         _parse_kv_list(str(env.get("tags", "")), opts.tags)
+        if ns.lp_refinery:
+            opts.feature_gates["LPRefinery"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts

@@ -367,7 +367,8 @@ def solve_classpack(problem: Problem,
                     existing_compat: Optional[np.ndarray] = None,
                     decode: bool = True,
                     max_alternatives: int = 60,
-                    guide: Optional[str] = "lp") -> PackingResult:
+                    guide: Optional[str] = "lp",
+                    refinery=None) -> PackingResult:
     """Host wrapper: sort classes → pad → kernel → decode.
 
     With decode=False only aggregate state is materialized (bench path:
@@ -379,7 +380,11 @@ def solve_classpack(problem: Problem,
     (measured 9.5% → ~2% on the bench's mixed shapes) while the scan
     kernel, audits, and decode stay the same code path.  Solves against
     existing capacity (consolidation probes, E>0) skip the guide: their
-    cost question is "fits into what's already bought", not mix."""
+    cost question is "fits into what's already bought", not mix.
+
+    `refinery` (ops/refinery.GuideRefinery) makes guide misses
+    non-blocking: the guided path answers from a stale mix or falls
+    through to the greedy kernel below while the LP refines off-tick."""
     E = 0 if existing_alloc is None else len(existing_alloc)
     ec = None
     if E:
@@ -388,7 +393,7 @@ def solve_classpack(problem: Problem,
     if guide == "lp" and E == 0 and decode:
         from .lpguide import solve_guided
         res = solve_guided(problem, max_alternatives=max_alternatives,
-                           max_nodes=max_nodes)
+                           max_nodes=max_nodes, refinery=refinery)
         if res is not None:
             return res
     requests, counts, compat, caps, order = _sorted_classes(problem, ec)

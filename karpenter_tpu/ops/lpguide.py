@@ -45,11 +45,13 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
 
 from .tensorize import Problem
+from ..utils import metrics
 
 _BIG = np.int32(2**30)
 
@@ -59,6 +61,22 @@ _BIG = np.int32(2**30)
 _MIX_CACHE: dict = {}
 _MIX_CACHE_MAX = 16
 _MIX_LOCK = threading.Lock()
+
+# stale-guide cache: keyed WITHOUT pod counts (class shapes ⊕ catalog), so
+# a tick whose counts changed but whose catalog fingerprint still matches
+# can rescale the freshest old mix instead of blocking on column
+# generation.  Entries carry a monotonic stamp; the refinery's staleness
+# window bounds how old a mix may serve.
+_STALE_CACHE: dict = {}
+_STALE_CACHE_MAX = 16
+
+# LP warm-start cache: class-shape digest → the terminal colgen support as
+# CONTENT keys (alloc-row bytes, price), so a changed catalog maps old
+# support columns back by content and counts-only deltas reuse them
+# directly.  Seeds only — a wrong seed just adds columns to the restricted
+# LP, never changes the optimum.
+_SUPPORT_CACHE: dict = {}
+_SUPPORT_CACHE_MAX = 32
 
 
 def _feasible_mask(problem: Problem) -> np.ndarray:
@@ -100,10 +118,36 @@ def _dedup_with_inverse(alloc: np.ndarray, price: np.ndarray,
     return alloc[keep], price[keep], compat[:, keep], group_of
 
 
+def _dual_certificate_ok(y: np.ndarray, mu: np.ndarray, reqf: np.ndarray,
+                         cnt: np.ndarray, z: float, pc: np.ndarray,
+                         pj: np.ndarray, xvals: np.ndarray,
+                         tol: float = 1e-5) -> bool:
+    """Cheap invariant pinning scipy's dual-sign convention (the pricing
+    step at the rc computation below silently inverts if a scipy release
+    flips marginal signs).  Two checks, both consequences of LP optimality
+    under the convention the pricing assumes:
+
+      * strong duality: the dual objective is b_eq·y + b_ub·μ, and b_ub is
+        all zeros here, so y·cnt must reconstruct the primal objective;
+      * complementary slackness: rc(c,j) = −y_c − Σ_r μ_jr·req[c,r] must
+        vanish on in-support basic pairs (x[c,j] > 0).
+
+    A flipped y fails the first; a flipped μ fails the second."""
+    scale = max(1.0, abs(z))
+    if abs(float(y @ cnt.astype(np.float64)) - z) > tol * scale:
+        return False
+    basic = xvals > 1e-9 * max(1.0, float(cnt.max()) if len(cnt) else 1.0)
+    if not basic.any():
+        return True
+    rc = -y[pc[basic]] - np.einsum("pr,pr->p", reqf[pc[basic]], mu[pj[basic]])
+    # rc is price-scaled (objective units); normalize like the duality gap
+    return float(np.abs(rc).max()) <= tol * scale
+
+
 def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
                  alloc: np.ndarray, price: np.ndarray,
                  pricing_rounds: int = 3, add_per_round: int = 16,
-                 tol: float = 1e-6):
+                 tol: float = 1e-6, seed_support: Optional[np.ndarray] = None):
     """Class-LP optimum by option-granular column generation.  Returns
     (x C×O, objective, info) or (None, None, info) when scipy is
     unavailable or the LP fails.
@@ -121,7 +165,13 @@ def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
     `add_per_round`, and stop as soon as the objective stops improving —
     duals of these degenerate masters routinely flag options that cannot
     actually improve the optimum, so improvement (not rc-cleanliness) is
-    the stopping criterion.  Certified bounds stay lpbound's job."""
+    the stopping criterion.  Certified bounds stay lpbound's job.
+
+    `seed_support` (option indices) unions extra columns into the initial
+    support — the refinery's warm start: the terminal support of the
+    previous solve of the same class shapes, mapped by content, usually
+    IS the new optimum's support, so the first restricted LP lands on it
+    and pricing terminates in one round."""
     try:
         from scipy import sparse
         from scipy.optimize import linprog
@@ -152,8 +202,11 @@ def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
                    np.max(reqf[:, None, :] * inv_alloc[None, :, :], axis=2),
                    np.inf)
     S[np.unique(np.argmin(ppm, axis=1))] = True
+    if seed_support is not None and len(seed_support):
+        S[np.asarray(seed_support, np.int64)] = True
 
-    info = {"method": "colgen-lp", "rounds": 0, "proven": False}
+    info = {"method": "colgen-lp", "rounds": 0, "proven": False,
+            "dual_check": True}
     x_full = None
     z = None
     for rnd in range(pricing_rounds):
@@ -197,6 +250,16 @@ def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
         # dual y) coeff 1 ⇒ rc(c,j) = −y_c − Σ_r μ_jr·req[c,r]
         y = res.eqlin.marginals
         mu = res.ineqlin.marginals.reshape(O, R)
+        if not _dual_certificate_ok(y, mu, reqf, cnt, z_new, pc, pj,
+                                    res.x[:P]):
+            # the duals don't certify this master (sign-convention drift
+            # or a degenerate basis): pricing with them could admit
+            # garbage columns or terminate early with a false "proven".
+            # Keep the primal solution — it is still restricted-LP
+            # optimal — but stop pricing and report it unproven.
+            info["dual_check"] = False
+            info["proven"] = False
+            break
         rc = -y[:, None] - np.einsum("cr,jr->cj", reqf, mu)
         optmin = np.where(compat & ~S[None, :], rc, np.inf).min(axis=0)
         worst = np.argsort(optmin)[:add_per_round]
@@ -207,6 +270,7 @@ def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
         S[worst] = True
     info["objective"] = z
     info["options_used"] = int(S.sum())
+    info["support"] = np.nonzero(S)[0]
     return x_full, z, info
 
 
@@ -252,8 +316,156 @@ def _stripe_group(amounts: np.ndarray, ng: int, req: np.ndarray,
     return fills, demoted
 
 
+def _cache_put(cache: dict, cache_max: int, key, value) -> None:
+    """Bounded check-then-insert under the shared lock (oldest-first
+    eviction, same discipline as classpack's content caches)."""
+    with _MIX_LOCK:
+        while len(cache) >= cache_max:
+            cache.pop(next(iter(cache)), None)
+        cache[key] = value
+
+
+def _mix_keys(problem: Problem, caps: np.ndarray, max_nodes: int):
+    """Content digests at three granularities over the RAW inputs (the
+    feasibility mask is a deterministic — and, at 50k scale, ~150ms —
+    function of them, so cache hits skip recomputing it):
+
+      * exact:  classes ⊕ counts ⊕ catalog ⊕ max_nodes — the mix cache key.
+        max_nodes is part of it: a gate rejection under a tight launch cap
+        must not disable the guide for the same pending set solved with a
+        roomier budget (review r5).
+      * stale:  the exact key MINUS counts/max_nodes — a tick whose pod
+        counts changed but whose catalog fingerprint still matches can
+        rescale an old mix (group space identical: the mask and dedup
+        don't read counts).
+      * shape:  class requests ⊕ caps only — the warm-start key; support
+        columns survive catalog edits because they're stored by content.
+    """
+    rank = (problem.option_rank if problem.option_rank is not None
+            else np.zeros(problem.num_options, np.int32))
+    req_b = problem.class_requests.tobytes()
+    cnt_b = problem.class_counts.tobytes()
+    compat_b = np.packbits(problem.class_compat).tobytes()
+    caps_b = caps.tobytes()
+    cat_b = (problem.option_alloc.tobytes() + problem.option_price.tobytes()
+             + np.ascontiguousarray(rank).tobytes())
+    key = hashlib.blake2b(
+        req_b + cnt_b + compat_b + caps_b + cat_b
+        + str(max_nodes).encode(), digest_size=16).digest()
+    stale_key = hashlib.blake2b(req_b + compat_b + caps_b + cat_b,
+                                digest_size=16).digest()
+    shape_key = hashlib.blake2b(req_b + caps_b, digest_size=16).digest()
+    return key, stale_key, shape_key
+
+
+def _round_mix(x: np.ndarray, targets: np.ndarray) -> np.ndarray:
+    """Largest-remainder rounding per class: integer y with
+    Σ_g y[c] == targets[c] exactly — no fractional leftovers ever reach
+    the (greedy-priced) remainder solve."""
+    y = np.floor(x)
+    frac = x - y
+    short = np.round(targets - y.sum(axis=1)).astype(np.int64)
+    for c in np.nonzero(short > 0)[0]:
+        top = np.argsort(-frac[c])[:short[c]]
+        y[c, top] += 1
+    return y
+
+
+def _compute_mix(problem: Problem, caps: np.ndarray, stale_key=None,
+                 shape_key=None, clock=time.monotonic):
+    """The expensive half of the guide: feasibility mask → dedup →
+    (warm-started) colgen LP → largest-remainder rounding.  Returns the
+    mix entry [y, n_g, group_of, z, ok, rejected] or None, refreshing the
+    stale-guide and warm-start caches when keys are given.  Runs on the
+    provisioning tick only when no refinery is wired — otherwise in the
+    refinery worker thread."""
+    ok = _feasible_mask(problem)
+    if ok.any(axis=1).sum() < 2:
+        return None
+    d_alloc, d_price, d_compat, group_of = _dedup_with_inverse(
+        problem.option_alloc.astype(np.float64),
+        problem.option_price.astype(np.float64), ok)
+    # hostname-capped classes are excluded from the mix: the pooled LP
+    # cannot honor per-node caps, so those classes go to the kernel
+    uncapped = caps >= _BIG
+    cnt_lp = np.where(uncapped, problem.class_counts, 0)
+    seed = None
+    if shape_key is not None:
+        support = _SUPPORT_CACHE.get(shape_key)
+        if support:
+            by_content = {(d_alloc[j].tobytes(), float(d_price[j])): j
+                          for j in range(len(d_price))}
+            seed = [by_content[k] for k in support if k in by_content]
+    x, z, info = exact_lp_mix(problem.class_requests, cnt_lp,
+                              d_compat, d_alloc, d_price,
+                              seed_support=seed)
+    if x is None:
+        return None
+    if shape_key is not None and info.get("support") is not None:
+        _cache_put(_SUPPORT_CACHE, _SUPPORT_CACHE_MAX, shape_key,
+                   [(d_alloc[j].tobytes(), float(d_price[j]))
+                    for j in info["support"]])
+    # the striper recomputes node counts from the rounded loads so the
+    # slight overfill vs the fractional optimum stays inside each group's
+    # ceil slack
+    y = _round_mix(x, cnt_lp)
+    loadg = np.einsum("cj,cr->jr", y,
+                      problem.class_requests.astype(np.float64))
+    n_g = np.max(loadg / np.maximum(d_alloc, 1e-12), axis=1)
+    if stale_key is not None:
+        _cache_put(_STALE_CACHE, _STALE_CACHE_MAX, stale_key, {
+            "x": x, "cnt": cnt_lp.astype(np.float64), "group_of": group_of,
+            "ok": ok, "alloc": d_alloc, "price": d_price, "stamp": clock()})
+    return [y, n_g, group_of, float(z), ok, False]
+
+
+def _stale_mix(problem: Problem, stale_key, caps: np.ndarray, now: float,
+               ttl: float):
+    """Rescale the freshest old mix whose catalog fingerprint still
+    matches (same classes/compat/caps/options — only pod counts differ)
+    to the current counts: per-class group distribution × new counts,
+    largest-remainder rounded.  Bounded by the staleness window `ttl`.
+    The gate's z is the rescaled mix's own fractional cost — achievable
+    by construction, with the greedy-compare backstop unchanged."""
+    ent = _STALE_CACHE.get(stale_key)
+    if ent is None or not (now - ent["stamp"] <= ttl):
+        return None
+    covered = ent["cnt"] > 0
+    uncapped = caps >= _BIG
+    cnt_lp = np.where(uncapped & covered, problem.class_counts, 0)
+    if not cnt_lp.any():
+        return None
+    frac = np.where(covered[:, None],
+                    ent["x"] / np.maximum(ent["cnt"], 1e-12)[:, None], 0.0)
+    x = frac * cnt_lp[:, None].astype(np.float64)
+    y = _round_mix(x, cnt_lp)
+    reqf = problem.class_requests.astype(np.float64)
+    inv_alloc = 1.0 / np.maximum(ent["alloc"], 1e-12)
+    n_g = np.max(np.einsum("cj,cr->jr", y, reqf) * inv_alloc, axis=1)
+    z_est = float((np.max(np.einsum("cj,cr->jr", x, reqf) * inv_alloc,
+                          axis=1) * ent["price"]).sum())
+    return [y, n_g, ent["group_of"], z_est, ent["ok"], False]
+
+
+def _refine_job(problem: Problem, caps: np.ndarray, max_nodes: int, key,
+                stale_key, shape_key, clock):
+    """Refinery worker body: compute the exact mix off the tick, land it
+    in the content-keyed cache (upgrading the next tick), then price the
+    greedy alternative so the refinery can raise the one-shot re-solve
+    hint when the refined mix is a real saving."""
+    hit = _compute_mix(problem, caps, stale_key, shape_key, clock=clock)
+    if hit is None:
+        return None
+    _cache_put(_MIX_CACHE, _MIX_CACHE_MAX, key, hit)
+    from .classpack import solve_classpack
+    greedy = solve_classpack(problem, max_nodes=max_nodes, decode=False,
+                             guide=None)
+    return {"z_lp": hit[3], "greedy_total": float(greedy.total_price)}
+
+
 def solve_guided(problem: Problem, max_alternatives: int = 60,
-                 max_nodes: int = 8192, ng_slack: float = 1.0):
+                 max_nodes: int = 8192, ng_slack: float = 1.0,
+                 refinery=None):
     """LP-guided solve: stripe the LP mix into concrete node fills, then
     run the pack kernel on what the LP cannot see.
 
@@ -272,6 +484,13 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     Returns a PackingResult indistinguishable from the greedy path's, or
     None when the guide does not apply (degenerate instance, scipy
     missing).  The mix is content-cached on (classes ⊕ catalog).
+
+    With a `refinery` (ops/refinery.GuideRefinery), a mix-cache miss
+    never blocks the caller on column generation: the freshest stale mix
+    whose catalog fingerprint still matches serves immediately (bounded
+    by the refinery's staleness window), else the caller falls back to
+    greedy for this tick — either way the exact problem signature is
+    enqueued and the refined mix upgrades the next tick.
     """
     from .classpack import resolve_alternatives, solve_classpack
     from .ffd import NodeDecision, PackingResult
@@ -283,55 +502,29 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     caps = (problem.class_node_cap if problem.class_node_cap is not None
             else np.full(C0, _BIG, np.int32))
 
-    # key over the RAW inputs — the feasibility mask is a deterministic
-    # (and, at 50k scale, ~150ms) function of them, so a cache hit skips
-    # recomputing it (it rides in the cached tuple).  max_nodes is part
-    # of the key: a gate rejection under a tight launch cap must not
-    # disable the guide for the same pending set solved with a roomier
-    # budget (review r5).
-    rank = (problem.option_rank if problem.option_rank is not None
-            else np.zeros(O0, np.int32))
-    key = hashlib.blake2b(
-        problem.class_requests.tobytes() + problem.class_counts.tobytes()
-        + np.packbits(problem.class_compat).tobytes() + caps.tobytes()
-        + problem.option_alloc.tobytes() + problem.option_price.tobytes()
-        + np.ascontiguousarray(rank).tobytes() + str(max_nodes).encode(),
-        digest_size=16).digest()
+    key, stale_key, shape_key = _mix_keys(problem, caps, max_nodes)
     hit = _MIX_CACHE.get(key)
+    path = "warm"
     if hit is None:
-        ok = _feasible_mask(problem)
-        if ok.any(axis=1).sum() < 2:
-            return None
-        d_alloc, d_price, d_compat, group_of = _dedup_with_inverse(
-            problem.option_alloc.astype(np.float64),
-            problem.option_price.astype(np.float64), ok)
-        # hostname-capped classes are excluded from the mix: the pooled LP
-        # cannot honor per-node caps, so those classes go to the kernel
-        uncapped = caps >= _BIG
-        cnt_lp = np.where(uncapped, problem.class_counts, 0)
-        x, z, info = exact_lp_mix(problem.class_requests, cnt_lp,
-                                  d_compat, d_alloc, d_price)
-        if x is None:
-            return None
-        # largest-remainder rounding per class: integer y[c,g] with
-        # Σ_g y = cnt_c exactly — no fractional leftovers ever reach the
-        # (greedy-priced) remainder solve; the striper recomputes node
-        # counts from the rounded loads so the slight overfill vs the
-        # fractional optimum stays inside each group's ceil slack
-        y = np.floor(x)
-        frac = x - y
-        short = np.round(cnt_lp - y.sum(axis=1)).astype(np.int64)
-        for c in np.nonzero(short > 0)[0]:
-            top = np.argsort(-frac[c])[:short[c]]
-            y[c, top] += 1
-        loadg = np.einsum("cj,cr->jr", y,
-                          problem.class_requests.astype(np.float64))
-        n_g = np.max(loadg / np.maximum(d_alloc, 1e-12), axis=1)
-        hit = [y, n_g, group_of, float(z), ok, False]
-        with _MIX_LOCK:
-            while len(_MIX_CACHE) >= _MIX_CACHE_MAX:
-                _MIX_CACHE.pop(next(iter(_MIX_CACHE)), None)
-            _MIX_CACHE[key] = hit
+        if refinery is not None:
+            # never block the tick on column generation: serve the
+            # freshest matching stale mix (or greedy), refine off-tick
+            hit = _stale_mix(problem, stale_key, caps, refinery.clock(),
+                             refinery.stale_ttl)
+            refinery.submit(key, lambda: _refine_job(
+                problem, caps, max_nodes, key, stale_key, shape_key,
+                refinery.clock))
+            if hit is None:
+                metrics.lpguide_requests().inc({"path": "cold"})
+                return None
+            path = "stale"
+        else:
+            path = "cold"
+            hit = _compute_mix(problem, caps, stale_key, shape_key)
+            if hit is None:
+                return None
+            _cache_put(_MIX_CACHE, _MIX_CACHE_MAX, key, hit)
+    metrics.lpguide_requests().inc({"path": path})
     x, n_g, group_of, z_lp, ok, rejected = hit
     if rejected:
         return None
@@ -434,6 +627,13 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     inv_node_alloc = 1.0 / np.maximum(alloc_int[node_oi_arr], 1)
     tuck_order = np.argsort(
         -(reqs_int / np.maximum(alloc_int.mean(axis=0), 1)).max(axis=1))
+    # tucked placements accumulate as (node, pod, class) ARRAYS — one
+    # np.repeat-style slice per round, one global stable argsort +
+    # boundary split at the end — instead of a per-pod Python append loop
+    # (O(remainder-pods) interpreter work on the 50k decode path)
+    tuck_node_idx: list = []
+    tuck_pod_ids: list = []
+    tuck_cls_ids: list = []
     for c in tuck_order:
         if rem[c] <= 0:
             continue
@@ -448,6 +648,7 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
         # pods the fleet's slivers could legally hold)
         placed_c = np.zeros(len(node_oi_arr), np.int64)
         cap_c = int(caps[c])
+        mem = members_arr[c]
         while rem[c] > 0:
             fits = node_ok & (free_mat >= rc[None, :]).all(axis=1) & \
                 (placed_c < cap_c)
@@ -461,17 +662,28 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
                 sel = np.argpartition(load, take - 1)[:take]
             else:
                 sel = np.nonzero(fits)[0]
-            mem = members_arr[c]
-            for i in sel:
-                bulk_pods[i].append(int(mem[ptr[c]]))
-                ptr[c] += 1
-                if c not in bulk_cls[i]:
-                    bulk_cls[i].append(int(c))
+            tuck_node_idx.append(sel.astype(np.int64))
+            tuck_pod_ids.append(mem[ptr[c]:ptr[c] + take])
+            tuck_cls_ids.append(np.full(take, c, np.int64))
+            ptr[c] += take
             used_mat[sel] += rc
             free_mat[sel] -= rc
             placed_c[sel] += 1
             consumed[c] += take
             rem[c] -= take
+    if tuck_node_idx:
+        tni = np.concatenate(tuck_node_idx)
+        tpi = np.concatenate(tuck_pod_ids)
+        tci = np.concatenate(tuck_cls_ids)
+        t_order = np.argsort(tni, kind="stable")
+        tni, tpi, tci = tni[t_order], tpi[t_order], tci[t_order]
+        t_starts = np.nonzero(np.diff(tni, prepend=np.int64(-1)))[0]
+        t_ends = np.append(t_starts[1:], len(tni))
+        for s, e in zip(t_starts, t_ends):
+            i = int(tni[s])
+            bulk_pods[i].extend(tpi[s:e].tolist())
+            # duplicates fine: cls_keys below sets/sorts per node
+            bulk_cls[i].extend(tci[s:e].tolist())
 
     # ---- remainder: what even the tuck couldn't place, capped classes ----
     rem_cls = np.nonzero(rem > 0)[0]

@@ -624,6 +624,48 @@ def controller_max_concurrent() -> Gauge:
         labels=("controller",))
 
 
+def lpguide_requests() -> Counter:
+    """Guide cache outcome per guided solve: path=warm (exact mix-cache
+    hit), stale (rescaled old mix within the staleness window), cold
+    (miss — greedy this tick, refinery enqueued, or the synchronous LP
+    when no refinery is wired).  Hit ratio = (warm+stale) / total."""
+    return REGISTRY.counter(
+        "karpenter_lpguide_guide_requests",
+        "Guided solves by mix-cache path (warm/stale/cold).",
+        labels=("path",))
+
+
+def refinery_queue_depth() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_lpguide_refinery_queue_depth",
+        "Refine jobs queued or running in the LP-guide refinery.")
+
+
+def refinery_refine_duration() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_lpguide_refinery_refine_duration_seconds",
+        "Wall time of one background mix refinement (colgen LP + greedy "
+        "price probe).",
+        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30))
+
+
+def refinery_cost_delta() -> Counter:
+    """Cost improvement the refinery realized: Σ (greedy price − refined
+    LP objective) over refinements whose saving cleared the upgrade
+    threshold — the $/h the NEXT tick's guided solve recovers vs the
+    greedy plan the cold tick shipped."""
+    return REGISTRY.counter(
+        "karpenter_lpguide_refinery_cost_delta_realized",
+        "Aggregate $/h saving of refined mixes over the greedy baseline.")
+
+
+def refinery_errors() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_lpguide_refinery_errors",
+        "Refinery degradations by reason (exception/queue_full).",
+        labels=("reason",))
+
+
 def make_cluster_collector(cluster, lock=None):
     """Scrape-time collector for per-node and pod-phase gauges: refreshes
     karpenter_nodes_{allocatable, system_overhead, total_pod_requests,

@@ -16,4 +16,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: XLA_FLAGS --xla_force_host_platform_device_count (set
+    # above) is the only spelling; it must land before backend init.
+    pass

@@ -82,6 +82,73 @@ class TestExactLPMix:
         assert z < 0.9 * sole
 
 
+class TestDualCertificate:
+    """The dual-sign invariant (_dual_certificate_ok) that pins scipy's
+    marginal-sign convention under the pricing step — a silent flip in a
+    scipy release would invert every reduced cost and break colgen
+    without any error."""
+
+    # one class (req 1 unit), one option (alloc 2 → m=2 pods/node, price
+    # 1): LP optimum x=2 nodes for cnt=4 pods, z=2.  The consistent duals
+    # under the pricing convention are y=0.5 (per-pod marginal cost) and
+    # μ=-0.5 (capacity row marginal), which satisfy rc = -y - μ·req = 0.
+    _y = np.array([0.5])
+    _mu = np.array([[-0.5]])
+    _reqf = np.array([[1.0]])
+    _cnt = np.array([4])
+    _pc = np.array([0])
+    _pj = np.array([0])
+    _x = np.array([2.0])
+
+    def test_consistent_duals_pass(self):
+        from karpenter_tpu.ops.lpguide import _dual_certificate_ok
+        assert _dual_certificate_ok(self._y, self._mu, self._reqf,
+                                    self._cnt, 2.0, self._pc, self._pj,
+                                    self._x)
+
+    def test_flipped_y_fails_strong_duality(self):
+        from karpenter_tpu.ops.lpguide import _dual_certificate_ok
+        assert not _dual_certificate_ok(-self._y, self._mu, self._reqf,
+                                        self._cnt, 2.0, self._pc, self._pj,
+                                        self._x)
+
+    def test_flipped_mu_fails_complementary_slackness(self):
+        from karpenter_tpu.ops.lpguide import _dual_certificate_ok
+        assert not _dual_certificate_ok(self._y, -self._mu, self._reqf,
+                                        self._cnt, 2.0, self._pc, self._pj,
+                                        self._x)
+
+    def test_real_lp_certifies(self):
+        prob = tensorize(_blend_pods(), _catalog_2ratio(), [NodePool()])
+        ok = _feasible_mask(prob)
+        da, dp, dc, _ = _dedup_with_inverse(
+            prob.option_alloc.astype(np.float64),
+            prob.option_price.astype(np.float64), ok)
+        x, _, info = exact_lp_mix(prob.class_requests, prob.class_counts,
+                                  dc, da, dp)
+        assert x is not None
+        assert info["dual_check"] is True
+        assert info["proven"] is True
+
+    def test_failed_certificate_demotes_to_unproven(self, monkeypatch):
+        """A failed invariant must not raise or discard the primal — it
+        marks the mix unproven (the acceptance gate then compares against
+        greedy before trusting it)."""
+        from karpenter_tpu.ops import lpguide
+        monkeypatch.setattr(lpguide, "_dual_certificate_ok",
+                            lambda *a, **k: False)
+        prob = tensorize(_blend_pods(), _catalog_2ratio(), [NodePool()])
+        ok = _feasible_mask(prob)
+        da, dp, dc, _ = _dedup_with_inverse(
+            prob.option_alloc.astype(np.float64),
+            prob.option_price.astype(np.float64), ok)
+        x, z, info = exact_lp_mix(prob.class_requests, prob.class_counts,
+                                  dc, da, dp)
+        assert x is not None and z is not None
+        assert info["dual_check"] is False
+        assert info["proven"] is False
+
+
 class TestStripeGroup:
     def test_conservation_and_capacity(self):
         rng = np.random.default_rng(7)

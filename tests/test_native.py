@@ -90,6 +90,23 @@ def test_native_honors_class_node_cap():
         assert len(n.pod_indices) == 1
 
 
+def test_native_matches_jax_on_inf_priced_only_fit():
+    # A pod whose ONLY fitting option is inf-priced must come back
+    # unschedulable from BOTH backends: the JAX kernel gates new-node
+    # opens on isfinite(price), while the native wrapper used to clamp
+    # inf to 3.4e38 — demoting the option to "most expensive" but still
+    # opening it when nothing else fit.
+    catalog = [make_type("a.small", 2, 4, 0.10),
+               make_type("huge", 64, 256, float("inf"))]
+    pods = [cpu_pod(cpu_m=32000), cpu_pod(cpu_m=500)]
+    prob = tensorize(pods, catalog, [NodePool()])
+    a = native.solve_ffd_native(prob)
+    b = solve_ffd(prob, backend="jax")
+    assert_same_result(a, b)
+    assert sorted(a.unschedulable) == [0]
+    assert [n.option.instance_type for n in a.nodes] == ["a.small"]
+
+
 def test_build_is_idempotent():
     assert native.build()
     assert native.build()

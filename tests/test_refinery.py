@@ -1,0 +1,186 @@
+"""Asynchronous LP-guide refinery (ops/refinery.py + the lpguide
+cold/stale/warm paths and the manager's one-shot upgrade hook).
+
+The refinery's contract is behavioral, so every test asserts through the
+solve path: a cold tick must return the greedy answer IMMEDIATELY, a
+stale guide may only be reused inside its staleness window, a refined
+mix must upgrade the next identical solve, and any refinery failure must
+leave the tick exactly where it would be with no refinery at all."""
+
+import numpy as np
+import pytest
+
+from test_lpguide import _blend_pods, _catalog_2ratio
+from karpenter_tpu.api.objects import NodePool
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import ImageInfo, SecurityGroupInfo, SubnetInfo
+from karpenter_tpu.operator import (ControllerManager, Operator, Options,
+                                    build_controllers)
+from karpenter_tpu.ops import lpguide
+from karpenter_tpu.ops.classpack import solve_classpack
+from karpenter_tpu.ops.refinery import GuideRefinery
+from karpenter_tpu.ops.tensorize import tensorize
+from karpenter_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_caches():
+    """Every test starts cache-cold and leaves nothing for the next."""
+    with lpguide._MIX_LOCK:
+        lpguide._MIX_CACHE.clear()
+        lpguide._STALE_CACHE.clear()
+        lpguide._SUPPORT_CACHE.clear()
+    yield
+    with lpguide._MIX_LOCK:
+        lpguide._MIX_CACHE.clear()
+        lpguide._STALE_CACHE.clear()
+        lpguide._SUPPORT_CACHE.clear()
+
+
+def _blend_problem(n=200):
+    return tensorize(_blend_pods(n), _catalog_2ratio(), [NodePool()])
+
+
+def test_cold_tick_uses_greedy_immediately():
+    """A mix-cache miss with a refinery answers with the greedy plan and
+    queues exactly one refine job — it never blocks on the LP."""
+    prob = _blend_problem()
+    greedy = solve_classpack(prob, guide=None)
+    ref = GuideRefinery(start=False)           # worker off: the LP CANNOT run
+    cold = solve_classpack(prob, refinery=ref)
+    assert cold.total_price == pytest.approx(greedy.total_price)
+    assert not cold.unschedulable
+    assert ref.pending() == 1
+    ref.stop()
+
+
+def test_refined_mix_upgrades_next_tick():
+    prob = _blend_problem()
+    greedy = solve_classpack(prob, guide=None)
+    ref = GuideRefinery(start=False)
+    cold = solve_classpack(prob, refinery=ref)
+    assert cold.total_price == pytest.approx(greedy.total_price)
+    ref.start()
+    assert ref.drain(timeout=60.0)
+    warm = solve_classpack(prob, refinery=ref)
+    assert warm.total_price < 0.8 * greedy.total_price
+    # the blend saves >> the 3% threshold, so the one-shot hint is up —
+    # exactly once
+    assert ref.take_upgrade() is True
+    assert ref.take_upgrade() is False
+    ref.stop()
+
+
+def test_stale_staleness_bound_honored():
+    """A stale guide (same catalog fingerprint, different pod counts) is
+    rescaled and reused INSIDE the ttl and ignored past it."""
+    fake = [1000.0]
+    ref = GuideRefinery(stale_ttl=50.0, clock=lambda: fake[0], start=False)
+    prob200 = _blend_problem(200)
+    solve_classpack(prob200, refinery=ref)     # cold: queues the job
+    ref.start()
+    assert ref.drain(timeout=60.0)             # stale entry stamped at 1000
+    ref.stop()                                 # worker off again: no restamp
+
+    fake[0] = 1040.0                           # 40s old — inside the window
+    prob144 = _blend_problem(144)
+    greedy144 = solve_classpack(prob144, guide=None)
+    stale = solve_classpack(prob144, refinery=ref)
+    assert stale.total_price < 0.8 * greedy144.total_price
+    assert not stale.unschedulable
+
+    fake[0] = 1051.0                           # 51s old — past the 50s ttl
+    prob112 = _blend_problem(112)
+    greedy112 = solve_classpack(prob112, guide=None)
+    expired = solve_classpack(prob112, refinery=ref)
+    assert expired.total_price == pytest.approx(greedy112.total_price)
+
+
+def test_refinery_crash_degrades_to_greedy(monkeypatch):
+    """Chaos: the LP itself blows up inside the worker on every job.  The
+    control loop must keep binding pods on the greedy path, count the
+    failures, and never surface the exception to a tick."""
+    def boom(*a, **k):
+        raise RuntimeError("chaos: colgen exploded")
+    monkeypatch.setattr(lpguide, "_compute_mix", boom)
+    errs_before = metrics.refinery_errors().value({"reason": "exception"})
+
+    clock = [10_000.0]
+    op = Operator(Options(batch_idle_duration=0.5,
+                          feature_gates={"Drift": True, "LPGuide": True,
+                                         "LPRefinery": True}),
+                  catalog=generate_catalog(25), clock=lambda: clock[0])
+    op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {}),
+                        SubnetInfo("s-b", "zone-b", 10_000, {})]
+    op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+    op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+    op.params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+    prov = mgr.controllers["provisioning"]
+    assert prov.refinery is not None           # the gate actually wired it
+    # small batches auto-route to the pod-granular FFD below the native
+    # cutover; pin the guided kernel so the refinery actually gets jobs
+    prov.solver = "classpack"
+
+    rng = np.random.default_rng(3)
+    from karpenter_tpu.api.objects import Pod
+    from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+    op.cluster.add_pods([Pod(requests=ResourceList({
+        CPU: int(rng.integers(200, 3000)),
+        MEMORY: int(rng.integers(256, 4096)) * 2**20})) for _ in range(30)])
+    try:
+        for _ in range(40):
+            clock[0] += 5.0
+            mgr.tick()
+            if not op.cluster.pending_pods():
+                break
+        assert not op.cluster.pending_pods()
+        assert prov.refinery.drain(timeout=10.0)
+        assert metrics.refinery_errors().value(
+            {"reason": "exception"}) > errs_before
+    finally:
+        mgr.stop()
+
+
+def test_stopped_refinery_still_solves_greedy():
+    """Worker thread dead (stop() — the crash-equivalent end state): the
+    solve path still answers every tick with greedy."""
+    prob = _blend_problem()
+    greedy = solve_classpack(prob, guide=None)
+    ref = GuideRefinery(start=False)
+    ref.stop()
+    r = solve_classpack(prob, refinery=ref)
+    assert r.total_price == pytest.approx(greedy.total_price)
+    assert not r.unschedulable
+
+
+def test_upgrade_hint_triggers_early_provision():
+    """The manager's one-shot hook: pending pods + a raised upgrade hint
+    re-solve BEFORE the batch window ripens, exactly once."""
+    clock = [10_000.0]
+    op = Operator(Options(batch_idle_duration=5.0, batch_max_duration=60.0,
+                          feature_gates={"Drift": True, "LPGuide": True,
+                                         "LPRefinery": True}),
+                  catalog=generate_catalog(25), clock=lambda: clock[0])
+    op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {})]
+    op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+    op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+    op.params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+    prov = mgr.controllers["provisioning"]
+    try:
+        from karpenter_tpu.api.objects import Pod
+        from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+        op.cluster.add_pods([Pod(requests=ResourceList(
+            {CPU: 500, MEMORY: 2**30})) for _ in range(4)])
+        r1 = mgr.tick()                       # opens the window; not ripe
+        assert "provisioning" not in r1
+        prov.refinery._upgrade.set()          # a refined mix just landed
+        r2 = mgr.tick()
+        assert "provisioning" in r2           # hook forced the re-solve
+        r3 = mgr.tick()
+        assert "provisioning" not in r3       # hint was one-shot
+    finally:
+        mgr.stop()
