@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test benchmark bench-smoke benchmark-interruption deflake native clean help
+.PHONY: test scale-test benchmark bench-smoke bench-consolidation benchmark-interruption deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -18,6 +18,9 @@ benchmark: ## Headline solve benchmark (one JSON line on stdout)
 
 bench-smoke: ## Fast bench sanity pass: 1k-homogeneous config only
 	python bench.py --smoke
+
+bench-consolidation: ## Consolidation-replay configs only (sweep + sequential baseline, refinery quiesced)
+	python bench.py --consolidation
 
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py

@@ -173,17 +173,28 @@ def run_config(name, pods, n_types, pools=None, iters=5, cold=False):
 
 
 def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
-                             iters=3):
+                             iters=3, sweep_shapes=(100, 250, 500)):
     """BASELINE config 4: 500 under-utilized nodes → multi-node replace
     simulation.  Built the way the reference's deprovisioning suite does
     (/root/reference/test/suites/scale/deprovisioning_test.go:325-428):
     provision a dense fleet, scale the workload down to ~28% utilization,
-    then evaluate consolidation.  The timed call is ONE batched simulate
-    over the FULL candidate set (the reference replays the scheduler per
-    candidate; r4's bench quietly timed a single candidate — fixed), plus
-    the decode=False feasibility-probe variant the controller's binary
-    search actually runs.  The decode=True call is the accepted-action
-    decode latency: it returns real per-pod assignments."""
+    then evaluate consolidation.
+
+    Three measurements:
+      * the ONE batched simulate over the FULL candidate set (decode=True
+        accepted-action latency, decode=False per-probe latency) — the
+        historical config-4 numbers;
+      * the batched consolidation sweep (`consolidation_action` on the
+        cached SimulationArena) at 100/250/500-candidate shapes: cold
+        (arena build) + warm p50 + aggregate device calls per tick;
+      * the sequential baseline (`batched_sweep=False`: binary-search +
+        screen loop, one tensorize+solve per probe) at the 100-candidate
+        shape — the speedup denominator.
+
+    The refinery worker stays quiesced throughout: no GuideRefinery is
+    started, and probe solves (decode=False / existing capacity) never
+    invoke the LP guide anyway — consolidation timings here are pure
+    sweep + decode."""
     import numpy as np
     from karpenter_tpu.api.objects import NodePool, Pod
     from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
@@ -192,6 +203,7 @@ def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
     from karpenter_tpu.controllers import Provisioner
     from karpenter_tpu.controllers.disruption import DisruptionController
     from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils import metrics
 
     rng = np.random.default_rng(3)
     catalog = generate_catalog(n_types)
@@ -226,7 +238,51 @@ def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
     log(f"[consolidation-replay] nodes={len(cluster.nodes)} "
         f"candidates={len(cands)} batched_simulate_p50={p50:.1f}ms "
         f"probe_p50={probe_p50:.1f}ms")
-    return p50
+    out = {"simulate_p50_ms": round(p50, 2),
+           "probe_p50_ms": round(probe_p50, 2)}
+
+    clock = lambda: time.time() + 10_000
+    for n_c in sweep_shapes:
+        ctrl_b = DisruptionController(provider, cluster, pools, clock=clock,
+                                      max_candidates=n_c)
+        cands_b = ctrl_b.candidates()
+        t0 = time.perf_counter()
+        ctrl_b.consolidation_action(cands_b)
+        cold_ms = (time.perf_counter() - t0) * 1000
+        warm = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            action = ctrl_b.consolidation_action(cands_b)
+            warm.append((time.perf_counter() - t0) * 1000)
+        sweep_p50 = float(np.median(warm))
+        calls = int(metrics.disruption_sweep_probes().value())
+        log(f"[consolidation-sweep-{n_c}] candidates={len(cands_b)} "
+            f"cold={cold_ms:.1f}ms warm_p50={sweep_p50:.1f}ms "
+            f"device_calls={calls} "
+            f"action={'none' if action is None else action.name}")
+        out[f"sweep_p50_ms_{n_c}"] = round(sweep_p50, 2)
+        out[f"sweep_cold_ms_{n_c}"] = round(cold_ms, 2)
+        out[f"probes_per_tick_{n_c}"] = calls
+
+    # sequential baseline (the pre-arena algorithm) at the 100-candidate
+    # shape — one evaluation is ~log2(N) probes each paying lower+tensorize
+    # +solve, so a single timed pass suffices after warmup via the probes
+    # above
+    ctrl_s = DisruptionController(provider, cluster, pools, clock=clock,
+                                  max_candidates=100, batched_sweep=False)
+    cands_s = ctrl_s.candidates()
+    seq = []
+    for _ in range(max(2, iters - 1)):
+        t0 = time.perf_counter()
+        ctrl_s.consolidation_action(cands_s)
+        seq.append((time.perf_counter() - t0) * 1000)
+    seq_p50 = float(np.median(seq))
+    out["sequential_p50_ms_100"] = round(seq_p50, 2)
+    base = out.get("sweep_p50_ms_100")
+    out["speedup_100"] = round(seq_p50 / base, 2) if base else None
+    log(f"[consolidation-sequential-100] p50={seq_p50:.1f}ms "
+        f"speedup_vs_sweep={out['speedup_100']}x")
+    return out
 
 
 def run_interruption_benchmark(sizes=(100, 1000, 5000, 15000)):
@@ -281,8 +337,9 @@ def _run_child(env, timeout=3000):
     the caller then falls back rather than crashing without a JSON line."""
     bench = os.path.abspath(__file__)
     args = [sys.executable, bench, "--run"]
-    if "--smoke" in sys.argv[1:]:
-        args.append("--smoke")
+    for flag in ("--smoke", "--consolidation"):
+        if flag in sys.argv[1:]:
+            args.append(flag)
     try:
         return subprocess.run(args, env=env, timeout=timeout).returncode
     except subprocess.TimeoutExpired:
@@ -314,12 +371,26 @@ def main():
     sys.exit(1 if rc is None else rc)
 
 
-def run_all(smoke=False):
+def run_all(smoke=False, consolidation=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
     fallback = os.environ.get("KARPENTER_TPU_BENCH_FALLBACK")
     rng = np.random.default_rng(42)
+
+    if consolidation:
+        # `make bench-consolidation`: only the consolidation-replay configs
+        # (refinery quiesced — no worker is ever started on this path)
+        cons = run_consolidation_replay()
+        tail = {"metric": "500-node consolidation sweep (100-candidate "
+                          "warm) p50 latency",
+                "value": cons.get("sweep_p50_ms_100"),
+                "unit": "ms",
+                "platform": platform,
+                "fallback": fallback}
+        tail.update({f"consolidation_{k}": v for k, v in cons.items()})
+        print(json.dumps(tail), flush=True)
+        return
 
     if smoke:
         # `make bench-smoke`: the 1k-homogeneous config only — a fast
@@ -345,8 +416,8 @@ def run_all(smoke=False):
         iters=3, cold=True)
     # config 3: 5k GPU pods
     run_config("5k-gpu", build_pods(40, 5_000, rng, gpu_frac=1.0), 600, iters=3)
-    # config 4: 500-node consolidation replay
-    run_consolidation_replay()
+    # config 4: 500-node consolidation replay + batched sweep shapes
+    cons = run_consolidation_replay()
     # interruption-controller throughput (the reference's `make benchmark`)
     run_interruption_benchmark()
     # config 5 (headline): 50k burst, 600 types, constraints + spot/od pricing
@@ -358,7 +429,7 @@ def run_all(smoke=False):
                                        iters=9)
 
     baseline_ms = 200.0
-    print(json.dumps({
+    tail = {
         "metric": "50k-pod x 600-type end-to-end schedule (tensorize+solve+decode) p50 latency",
         "value": round(p50, 2),
         "unit": "ms",
@@ -368,11 +439,14 @@ def run_all(smoke=False):
         "stale_p50_ms_10k": None if stale10_p50 is None else round(stale10_p50, 2),
         "warm_p50_ms_10k": round(warm10_p50, 2),
         "fallback": fallback,
-    }), flush=True)
+    }
+    tail.update({f"consolidation_{k}": v for k, v in cons.items()})
+    print(json.dumps(tail), flush=True)
 
 
 if __name__ == "__main__":
     if "--run" in sys.argv[1:]:
-        run_all(smoke="--smoke" in sys.argv[1:])
+        run_all(smoke="--smoke" in sys.argv[1:],
+                consolidation="--consolidation" in sys.argv[1:])
     else:
         main()

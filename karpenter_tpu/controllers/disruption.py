@@ -124,6 +124,25 @@ def node_disruption_cost(node: Node, pool: NodePool, now: float) -> float:
     return cost
 
 
+def _search_frontier(lo: int, hi: int, cap: int = 31) -> List[int]:
+    """Every mid the binary search over [lo, hi] can reach in its next few
+    levels — whole levels of the mid decision tree while they fit in `cap`
+    rows (one sweep bucket), always at least the first level.  Sibling
+    subtrees cover disjoint ranges, so the mids are distinct and the tree
+    over [1, N] has depth ~log₂N: cap=31 covers 5 levels per round, ≤2
+    rounds at any realistic candidate count."""
+    out: List[int] = []
+    level = [(lo, hi)]
+    while level:
+        mids = [(l + h) // 2 for l, h in level if l <= h]
+        if not mids or (out and len(out) + len(mids) > cap):
+            break
+        out.extend(mids)
+        level = [iv for l, h in level if l <= h
+                 for iv in ((l, (l + h) // 2 - 1), ((l + h) // 2 + 1, h))]
+    return out
+
+
 class DisruptionController:
     """Single-action disruption loop over cluster state."""
 
@@ -139,7 +158,12 @@ class DisruptionController:
                  terminator: Optional["TerminationController"] = None,
                  spot_min_flexibility: int = SPOT_TO_SPOT_MIN_ALTERNATIVES,
                  recorder=None,
-                 lp_guide: bool = True):
+                 lp_guide: bool = True,
+                 # batched prefix/candidate probing on the cached
+                 # simulation arena (≤3 aggregate device calls per tick);
+                 # False = the original sequential binary-search +
+                 # per-candidate screen loop
+                 batched_sweep: bool = True):
         from ..utils.events import Recorder
         self.provider = provider
         self.cluster = cluster
@@ -152,7 +176,9 @@ class DisruptionController:
         self.max_candidates = max_candidates
         self.spot_min_flexibility = spot_min_flexibility
         self.lp_guide = lp_guide
+        self.batched_sweep = batched_sweep
         self._empty_since: Dict[str, float] = {}  # node → first seen empty
+        self._arena_cache = None  # (fingerprint, SimulationArena)
 
     # ------------------------------------------------------------------
     # candidate discovery
@@ -198,7 +224,17 @@ class DisruptionController:
                 disruption_cost=node_disruption_cost(node, pool, now),
                 price=node.price))
         out.sort(key=lambda c: (c.disruption_cost, c.name))
-        return out[:self.max_candidates]
+        if len(out) > self.max_candidates:
+            # no silent caps: a truncated discovery pass means this tick did
+            # NOT sweep everything — say so and count it
+            dropped = len(out) - self.max_candidates
+            log.info("candidate discovery truncated: %d of %d kept "
+                     "(max_candidates=%d), %d dropped",
+                     self.max_candidates, len(out), self.max_candidates,
+                     dropped)
+            metrics.disruption_candidates_truncated().inc(by=dropped)
+            out = out[:self.max_candidates]
+        return out
 
     # ------------------------------------------------------------------
     # simulation: the scheduler re-used as the consolidation simulator
@@ -437,12 +473,147 @@ class DisruptionController:
 
     def consolidation_action(self, cands: List[Candidate]) -> Optional[Action]:
         """Multi-node delete first (largest feasible prefix of the
-        cost-sorted candidates, binary search like the reference's
-        multi-node consolidation), then single-node delete-or-replace."""
+        cost-sorted candidates), then single-node delete-or-replace.
+
+        The batched path answers every probe the sequential algorithm would
+        ask from AT MOST THREE aggregate device calls on a cached
+        `SimulationArena`: the delete binary search's reachable mids as
+        1-2 batched frontier probes, then (only if no delete wins) one
+        all-candidate replacement screen.  Fully-decoded solves remain only
+        for the winning action — the decode-audit fallback is unchanged."""
         cands = [c for c in cands if self._consolidatable(c)]
         if not cands:
             return None
+        if not self.batched_sweep:
+            return self._consolidation_action_sequential(cands)
 
+        sweep_hist = metrics.disruption_sweep_duration()
+        t0 = time.perf_counter()
+        arena = self._arena_for(cands)
+        # PDB composition over prefix unions, computed incrementally on the
+        # host in ONE pass (the sequential path rebuilt the union and
+        # rescanned every PDB per binary-search step)
+        evict_ok = self._prefix_evictable(cands)
+        # replay the sequential binary search exactly, but evaluate its
+        # probes in batched rounds: each round solves EVERY prefix the
+        # search could still reach in its next few levels (whole levels of
+        # the mid decision tree, ≤31 rows ⇒ ≤2 rounds at any N), then
+        # walks the real outcomes.  The search only ever reads mids we
+        # evaluated with the same oracle, so best_mid is identical to the
+        # sequential result even when feasibility is non-monotone in the
+        # prefix length
+        device_calls = 0
+        feas: Dict[int, bool] = {}
+        lo, hi, best_mid = 1, len(cands), 0
+        while lo <= hi:
+            mids = _search_frontier(lo, hi)
+            need = [k for k in mids if k not in feas]
+            if need:
+                sweep = arena.sweep_prefix_subset(need)
+                device_calls += sweep.device_calls
+                for i, k in enumerate(need):
+                    feas[k] = evict_ok[k] and sweep.feasible_delete(i)
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                if mid not in feas:
+                    break
+                if feas[mid]:
+                    best_mid = mid
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
+        sweep_hist.observe(time.perf_counter() - t0, {"phase": "prefix"})
+        # the aggregate probe is optimistic about intra-batch topology
+        # (spread/anti-affinity audits need assignments): decode the winner
+        # — common case, ONE decoded solve total.  If the audit rejects it,
+        # rerun the binary search with decoded probes over the remaining
+        # range: the pre-probe algorithm, paid only when audits bite.
+        best = self._decoded_delete_action(cands[:best_mid]) if best_mid else None
+        if best is None and best_mid > 1:
+            lo, hi = 1, best_mid - 1
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                a = self._decoded_delete_action(cands[:mid])
+                if a is not None:
+                    best = a
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
+        if best is not None:
+            metrics.disruption_sweep_probes().set(device_calls)
+            return best
+
+        # single-node pass: one batched screen over ALL candidates (the
+        # sequential loop paid one aggregate solve per candidate), then the
+        # decoded accept path candidate-by-candidate in discovery order —
+        # first acceptance wins, exactly like the sequential loop.
+        t1 = time.perf_counter()
+        screen = arena.sweep_singles()
+        sweep_hist.observe(time.perf_counter() - t1, {"phase": "single"})
+        device_calls += screen.device_calls
+        metrics.disruption_sweep_probes().set(device_calls)
+        for i, c in enumerate(cands):
+            if not c.reschedulable:
+                continue
+            if screen.unschedulable[i] or screen.new_nodes[i] > 1:
+                continue
+            if screen.new_nodes[i] and screen.total_price[i] >= c.price:
+                continue
+            action = self._decoded_single_action(c)
+            if action is not None:
+                return action
+        return None
+
+    def _arena_for(self, cands: List[Candidate]):
+        """Size-1 simulation-arena cache keyed on the cluster-state
+        fingerprint: repeat probes within a tick and unchanged clusters
+        across ticks reuse the tensorized arrays and swap only masks."""
+        from ..api.resources import DEFAULT_AXES
+        from ..ops.tensorize import (SimulationArena, _catside_fingerprint,
+                                     arena_fingerprint)
+        catalog = self.provider.get_instance_types()
+        pools = list(self.nodepools.values())
+        ncs = getattr(self.provider, "node_classes", None)
+        cat_key = _catside_fingerprint(catalog, pools, DEFAULT_AXES,
+                                       node_classes=ncs)
+        key = arena_fingerprint(cands, self.cluster.nodes.values(), cat_key)
+        cached = self._arena_cache
+        if cached is not None and cached[0] == key:
+            metrics.disruption_arena_requests().inc({"outcome": "hit"})
+            return cached[1]
+        arena = SimulationArena(cands, self.cluster, catalog, pools,
+                                node_classes=ncs)
+        self._arena_cache = (key, arena)
+        metrics.disruption_arena_requests().inc({"outcome": "build"})
+        return arena
+
+    def _prefix_evictable(self, cands: List[Candidate]) -> List[bool]:
+        """evict_ok[k] ⇔ evicting the union of cands[:k] clears every PDB
+        budget — `cluster.evictable` over growing prefixes in one
+        incremental pass (draws only grow, so the first failing prefix
+        poisons all larger ones)."""
+        n = len(cands)
+        if not self.cluster.pdbs:
+            return [True] * (n + 1)
+        budgets = self.cluster.pdb_budgets()
+        ok = [True]
+        draw: Dict[str, int] = {}
+        good = True
+        for c in cands:
+            if good:
+                for p in c.reschedulable:
+                    for pdb in self.cluster.pdbs.values():
+                        if pdb.matches(p):
+                            draw[pdb.name] = draw.get(pdb.name, 0) + 1
+                good = all(budgets[name] >= v for name, v in draw.items())
+            ok.append(good)
+        return ok
+
+    def _consolidation_action_sequential(self, cands: List[Candidate]
+                                         ) -> Optional[Action]:
+        """The pre-arena algorithm (binary search + per-candidate screen
+        loop, one tensorize + aggregate solve per probe): the oracle the
+        batched sweep's parity tests run against, and the escape hatch."""
         # multi-node / single-node DELETE: pods fit on surviving nodes alone.
         # The union of a subset's evictions must clear the PDB budgets too —
         # per-node checks in candidates() don't compose.  Probes run the
@@ -462,11 +633,6 @@ class DisruptionController:
                 lo = mid + 1
             else:
                 hi = mid - 1
-        # the aggregate probe is optimistic about intra-batch topology
-        # (spread/anti-affinity audits need assignments): decode the winner
-        # — common case, ONE decoded solve total.  If the audit rejects it,
-        # rerun the binary search with decoded probes over the remaining
-        # range: the pre-probe algorithm, paid only when audits bite.
         best = self._decoded_delete_action(cands[:best_mid]) if best_mid else None
         if best is None and best_mid > 1:
             lo, hi = 1, best_mid - 1
@@ -494,43 +660,50 @@ class DisruptionController:
                 continue
             if screen.nodes and screen.total_price >= c.price:
                 continue
-            problem, result, survivors = self.simulate(
-                [c], allow_new=True, max_total_price=c.price)
-            if result.unschedulable or len(result.nodes) > 1:
-                continue
-            if not result.nodes:   # pure delete — survivors absorb everything
-                return Action(kind="delete", reason="consolidation",
-                              candidates=[c], simulation=result,
-                              problem=problem, surviving_nodes=survivors)
-            if result.total_price >= c.price:
-                continue
-            # spot→spot replacement needs flexibility (the reference's ≥15
-            # cheaper-offerings floor): count only SPOT alternatives strictly
-            # cheaper than the replaced node — on-demand options don't keep a
-            # spot launch flexible. Clamped to how many cheaper spot options
-            # the pool's catalog has at all, so small catalogs still
-            # exercise the path while catalog-scale runs enforce the full 15.
-            chosen = result.nodes[0]
-            if (c.node.capacity_type == wk.CAPACITY_TYPE_SPOT
-                    and chosen.option.capacity_type == wk.CAPACITY_TYPE_SPOT):
-                # distinct cheaper spot TYPES, matching spot_alts' dedup —
-                # counting zone-expanded options would inflate the clamp and
-                # permanently block spot→spot moves on multi-zone catalogs
-                pool_spot_cheaper = len({
-                    o.instance_type for o in problem.options
-                    if o.capacity_type == wk.CAPACITY_TYPE_SPOT
-                    and o.pool == chosen.option.pool and o.price < c.price})
-                floor = min(self.spot_min_flexibility, pool_spot_cheaper)
-                spot_alts = {a.instance_type for a in chosen.alternatives
-                             if a.capacity_type == wk.CAPACITY_TYPE_SPOT
-                             and a.price < c.price}
-                spot_alts.add(chosen.option.instance_type)
-                if len(spot_alts) < floor:
-                    continue
-            return Action(kind="replace", reason="consolidation",
-                          candidates=[c], simulation=result, problem=problem,
-                          surviving_nodes=survivors)
+            action = self._decoded_single_action(c)
+            if action is not None:
+                return action
         return None
+
+    def _decoded_single_action(self, c: Candidate) -> Optional[Action]:
+        """Fully-decoded single-candidate delete-or-replace: the accept path
+        both the batched screen and the sequential screen feed into."""
+        problem, result, survivors = self.simulate(
+            [c], allow_new=True, max_total_price=c.price)
+        if result.unschedulable or len(result.nodes) > 1:
+            return None
+        if not result.nodes:   # pure delete — survivors absorb everything
+            return Action(kind="delete", reason="consolidation",
+                          candidates=[c], simulation=result,
+                          problem=problem, surviving_nodes=survivors)
+        if result.total_price >= c.price:
+            return None
+        # spot→spot replacement needs flexibility (the reference's ≥15
+        # cheaper-offerings floor): count only SPOT alternatives strictly
+        # cheaper than the replaced node — on-demand options don't keep a
+        # spot launch flexible. Clamped to how many cheaper spot options
+        # the pool's catalog has at all, so small catalogs still
+        # exercise the path while catalog-scale runs enforce the full 15.
+        chosen = result.nodes[0]
+        if (c.node.capacity_type == wk.CAPACITY_TYPE_SPOT
+                and chosen.option.capacity_type == wk.CAPACITY_TYPE_SPOT):
+            # distinct cheaper spot TYPES, matching spot_alts' dedup —
+            # counting zone-expanded options would inflate the clamp and
+            # permanently block spot→spot moves on multi-zone catalogs
+            pool_spot_cheaper = len({
+                o.instance_type for o in problem.options
+                if o.capacity_type == wk.CAPACITY_TYPE_SPOT
+                and o.pool == chosen.option.pool and o.price < c.price})
+            floor = min(self.spot_min_flexibility, pool_spot_cheaper)
+            spot_alts = {a.instance_type for a in chosen.alternatives
+                         if a.capacity_type == wk.CAPACITY_TYPE_SPOT
+                         and a.price < c.price}
+            spot_alts.add(chosen.option.instance_type)
+            if len(spot_alts) < floor:
+                return None
+        return Action(kind="replace", reason="consolidation",
+                      candidates=[c], simulation=result, problem=problem,
+                      surviving_nodes=survivors)
 
     def _decoded_delete_action(self, subset: List[Candidate]) -> Optional[Action]:
         """Fully-decoded delete feasibility (incl. the batch-topology audit)

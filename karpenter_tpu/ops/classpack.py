@@ -265,6 +265,176 @@ def class_pack_aggregate_kernel_fresh(requests, counts, compat_packed,
         init_option, init_used, max_nodes)
 
 
+@partial(jax.jit, static_argnames=("max_nodes",))
+def class_pack_sweep_kernel(requests, counts_b, compat_packed, node_cap,
+                            alloc, price, rank, col_mask_b, price_cap_b,
+                            init_option, init_used, max_nodes: int):
+    """B masked aggregate solves in ONE device call (vmap over the batch
+    axis) — the consolidation sweep's kernel.
+
+    Shared (unbatched): the padded class arrays, the column catalog
+    (options + existing-node columns), and the pre-opened slot state.
+    Per-sub-problem (leading B axis): `counts_b` (which classes this probe
+    reschedules), `col_mask_b` (False == this column is excluded — the
+    probe's "what if these nodes were gone"), and `price_cap_b` (options
+    priced >= cap are unlaunchable, the strictly-cheaper replacement rule).
+
+    Everything derived only from the shared arrays (pods-per-node m_all,
+    the compat unpack) stays unbatched under vmap, so the B-fold cost is
+    the scan itself — B sequential probes become one padded program with a
+    single B×3 device→host fetch: [total_cost, n_new, n_unsched] per row."""
+    compat = jnp.unpackbits(compat_packed, axis=1,
+                            count=alloc.shape[0]).astype(bool)
+
+    def one(counts, colmask, cap):
+        comp = compat & colmask[None, :]
+        pr = jnp.where(colmask & (price < cap), price, jnp.inf)
+        flat = class_pack_aggregate_kernel(
+            requests, counts, comp, node_cap, alloc, pr, rank,
+            init_option, init_used, max_nodes)
+        # n_new from the per-option launch counts, NOT n_open: pre-opened
+        # existing columns carry +inf price and never count as launches
+        return jnp.stack([flat[0], jnp.sum(flat[3:]), flat[2]])
+
+    return jax.vmap(one)(counts_b, col_mask_b, price_cap_b)
+
+
+# batch-axis padding buckets for the sweep (compile reuse across candidate
+# counts), and a memory guard: the vmapped ok_all mask materializes
+# B×Cpad×Opad bools, so the per-call batch is clamped to keep that under
+# ~256M elements — larger sweeps chunk into several calls
+_SWEEP_B_BUCKETS = (8, 32, 128, 512)
+_SWEEP_MASK_BUDGET = 1 << 28
+
+
+def solve_classpack_sweep(problem: Problem,
+                          counts_b: np.ndarray,
+                          existing_alloc: Optional[np.ndarray] = None,
+                          existing_used: Optional[np.ndarray] = None,
+                          existing_compat: Optional[np.ndarray] = None,
+                          exist_mask_b: Optional[np.ndarray] = None,
+                          price_cap_b: Optional[np.ndarray] = None,
+                          max_nodes: int = 8192):
+    """Host wrapper for the batched sweep: one padding/lowering pass shared
+    by all B sub-problems, then bucket-padded kernel calls.
+
+    `counts_b` (B×C, problem class order) gives each sub-problem's pod
+    multiset; classes with count 0 are exact no-ops in the scan.
+    `exist_mask_b` (B×E bool, False == excluded) masks existing-node
+    columns per sub-problem; `price_cap_b` (B float) strictly bounds
+    launchable option prices (None/inf == no cap).  Returns a SweepResult
+    whose rows match what decode=False solve_classpack calls over the
+    same masked sub-problems would report."""
+    from .ffd import SweepResult
+
+    E = 0 if existing_alloc is None else len(existing_alloc)
+    ec = None
+    if E:
+        ec = existing_compat if existing_compat is not None else \
+            np.ones((problem.num_classes, E), bool)
+    order = problem.class_order()
+    requests = problem.class_requests[order]
+    compat = problem.class_compat[order]
+    if ec is not None:
+        compat = np.concatenate([compat, ec[order]], axis=1)
+    caps = (problem.class_node_cap if problem.class_node_cap is not None
+            else np.full(problem.num_classes, 2**30, np.int32))[order]
+    counts_b = np.asarray(counts_b, np.int32)[:, order]
+    B, C = counts_b.shape
+    R = requests.shape[1]
+
+    alloc = problem.option_alloc
+    price = problem.option_price.astype(np.float32)
+    O = alloc.shape[0]
+    if E:
+        alloc = np.concatenate([alloc, existing_alloc.astype(np.float32)],
+                               axis=0)
+        price = np.concatenate([price, np.full(E, np.inf, np.float32)])
+    if alloc.shape[0] == 0:
+        per = counts_b.sum(axis=1).astype(np.int32)
+        return SweepResult(total_price=np.zeros(B, np.float32),
+                           new_nodes=np.zeros(B, np.int32),
+                           unschedulable=per, device_calls=0)
+    rank = np.zeros(alloc.shape[0], np.int32)
+    rank[:O] = problem.option_rank
+
+    Cpad = pad_to(C, (64, 256, 1024, 4096))
+    Opad = pad_to(alloc.shape[0], (512, 2048, 4096, 8192, 32768))
+    req_p = np.zeros((Cpad, R), np.int32)
+    req_p[:C] = requests.astype(np.int32)
+    cap_p = np.full(Cpad, 2**30, np.int32)
+    cap_p[:C] = caps
+    comp_p = np.zeros((Cpad, Opad), bool)
+    comp_p[:C, :alloc.shape[0]] = compat
+    packed = np.packbits(comp_p, axis=1)
+    # int32 lowering TRUNCATES fractional allocatable exactly like
+    # solve_classpack's astype — ceil here would let the sweep fit a pod
+    # the sequential probe rejects
+    alloc_p = np.zeros((Opad, R), np.int32)
+    alloc_p[:alloc.shape[0]] = alloc.astype(np.int32)
+    price_p = np.full(Opad, np.inf, np.float32)
+    price_p[:alloc.shape[0]] = price
+    rank_p = np.full(Opad, 2**30 - 1, np.int32)
+    rank_p[:alloc.shape[0]] = rank
+
+    # finer slot buckets than the single-solve path: the vmapped scan's
+    # per-step cost is B×K, so a 1229-slot problem landing in an 8192
+    # bucket would cost 6.7x its useful work ACROSS THE WHOLE BATCH.
+    # K = P + E always suffices: each scan step opens at most one node per
+    # remaining pod, so new slots never exceed the row's pod count
+    P = int(counts_b.sum(axis=1).max()) if B else 0
+    K = max(min(max_nodes,
+                pad_to(P + E, (256, 512, 1024, 2048, 4096, 8192))),
+            E + 1)
+    init_option = np.full(K, -1, np.int32)
+    init_used = np.zeros((K, R), np.int32)
+    if E:
+        init_option[:E] = np.arange(O, O + E, dtype=np.int32)
+        if existing_used is not None:
+            init_used[:E] = np.ceil(existing_used).astype(np.int32)
+
+    cnt_p = np.zeros((B, Cpad), np.int32)
+    cnt_p[:, :C] = counts_b
+    mask_p = np.zeros((B, Opad), bool)
+    mask_p[:, :alloc.shape[0]] = True
+    if E and exist_mask_b is not None:
+        mask_p[:, O:O + E] = np.asarray(exist_mask_b, bool)
+    caps_b = (np.full(B, np.inf, np.float32) if price_cap_b is None
+              else np.asarray(price_cap_b, np.float32))
+
+    chunk = max(_SWEEP_B_BUCKETS[0], _SWEEP_MASK_BUDGET // (Cpad * Opad))
+    chunk = next((b for b in _SWEEP_B_BUCKETS if b >= min(chunk, B)),
+                 _SWEEP_B_BUCKETS[-1])
+    d_req, d_packed, d_cap = (jnp.asarray(req_p), jnp.asarray(packed),
+                              jnp.asarray(cap_p))
+    d_alloc, d_price, d_rank = (jnp.asarray(alloc_p), jnp.asarray(price_p),
+                                jnp.asarray(rank_p))
+    d_iopt, d_iused = jnp.asarray(init_option), jnp.asarray(init_used)
+    cost = np.zeros(B, np.float32)
+    n_new = np.zeros(B, np.int32)
+    unsched = np.zeros(B, np.int32)
+    calls = 0
+    for s in range(0, B, chunk):
+        e = min(s + chunk, B)
+        Bp = next(b for b in _SWEEP_B_BUCKETS if b >= e - s) \
+            if e - s <= _SWEEP_B_BUCKETS[-1] else e - s
+        cb = np.zeros((Bp, Cpad), np.int32)
+        cb[:e - s] = cnt_p[s:e]
+        mb = np.zeros((Bp, Opad), bool)
+        mb[:e - s] = mask_p[s:e]
+        pb = np.full(Bp, np.inf, np.float32)
+        pb[:e - s] = caps_b[s:e]
+        out = np.asarray(class_pack_sweep_kernel(
+            d_req, jnp.asarray(cb), d_packed, d_cap, d_alloc, d_price,
+            d_rank, jnp.asarray(mb), jnp.asarray(pb), d_iopt, d_iused, K))
+        calls += 1
+        cost[s:e] = out[:e - s, 0]
+        n_new[s:e] = np.rint(out[:e - s, 1]).astype(np.int32)
+        unsched[s:e] = np.rint(out[:e - s, 2]).astype(np.int32)
+    return SweepResult(total_price=cost, new_nodes=n_new,
+                       unschedulable=unsched, device_calls=calls)
+
+
 # device-resident catalog cache: (content fingerprint, device) → jax arrays.
 # The catalog side (alloc/price/rank) changes only on ICE/pricing seq bumps,
 # so consecutive solves reuse the same device buffers instead of re-uploading.

@@ -133,6 +133,24 @@ class PackingResult:
                 + len(self.existing_assignments))
 
 
+@dataclass
+class SweepResult:
+    """Aggregate verdicts for B masked sub-problems solved in one (or a few
+    bucket-padded) device calls — the batched consolidation sweep's output.
+    Row b answers the b-th probe exactly as a decode=False PackingResult
+    would: could the probe's pods land on the unmasked columns, how many
+    NEW nodes would launch, and at what launch cost."""
+    total_price: np.ndarray     # B float32 — price of newly-launched nodes
+    new_nodes: np.ndarray       # B int32  — nodes launched (existing excluded)
+    unschedulable: np.ndarray   # B int32  — pods left unplaced
+    device_calls: int = 1       # padded kernel invocations this sweep took
+
+    def feasible_delete(self, b: int) -> bool:
+        """The delete-probe contract: every pod lands on survivors alone."""
+        return (int(self.unschedulable[b]) == 0
+                and int(self.new_nodes[b]) == 0)
+
+
 # below this many rows the native C++ packer beats a device kernel launch
 NATIVE_CUTOVER_ROWS = 256
 

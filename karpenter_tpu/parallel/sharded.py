@@ -33,6 +33,28 @@ from ..ops.classpack import (class_pack_aggregate_kernel,
                              class_pack_assign_kernel)
 from ..ops.tensorize import Problem, pad_to
 
+# jax moved shard_map out of jax.experimental at 0.6; the pinned toolchain
+# (0.4.x) only ships the experimental spelling, whose rep-checker needs
+# explicit varying-marking (lax.pcast) that ALSO doesn't exist there yet.
+# Resolve once: prefer the public API, else wrap the experimental one with
+# check_rep=False (the per-shard packing state is trivially mesh-varying —
+# each device owns disjoint bins — so skipping the replication proof is
+# sound) and make the varying-mark a no-op.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+
+    def _mark_varying(x, axes):
+        return jax.lax.pcast(x, axes, to='varying')
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+    def _mark_varying(x, axes):
+        return x
+
 SHARD_AXIS = "pods"
 # hybrid-mesh axis names: the host axis rides DCN, the per-host chip axis
 # rides ICI — collectives reduce over ICI first so only one partial per
@@ -109,11 +131,9 @@ def _sharded_pack(requests, counts_sharded, compat, node_cap, alloc, price,
             counts_local = counts_local[0]
         K = max_nodes_per_shard
         # mark per-shard state as mesh-varying (each device packs its own bins)
-        init_option = jax.lax.pcast(jnp.full((K,), -1, jnp.int32),
-                                    axes, to='varying')
-        init_used = jax.lax.pcast(
-            jnp.zeros((K, requests.shape[1]), jnp.int32),
-            axes, to='varying')
+        init_option = _mark_varying(jnp.full((K,), -1, jnp.int32), axes)
+        init_used = _mark_varying(
+            jnp.zeros((K, requests.shape[1]), jnp.int32), axes)
         # same guarded reduction as the single-chip aggregate path —
         # flat = [cost, n_open, n_unsched, nodes_per_option…]
         flat = class_pack_aggregate_kernel(
@@ -125,7 +145,7 @@ def _sharded_pack(requests, counts_sharded, compat, node_cap, alloc, price,
             flat = jax.lax.psum(flat, ax)
         return flat[(None,) * unit_dims]
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(*axes),),
         out_specs=P(*axes))
@@ -163,7 +183,7 @@ def _sharded_assign(requests, counts_sharded, compat_packed_sharded,
         idx = (None,) * unit_dims
         return assignment[idx], slot_option[idx], n_unsched[idx]
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(*axes), P(*axes), P(*axes), P(*axes)),
         out_specs=(P(*axes), P(*axes), P(*axes)))

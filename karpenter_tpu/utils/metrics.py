@@ -402,6 +402,43 @@ def disruption_eligible_nodes() -> Gauge:
         labels=("method",))
 
 
+def disruption_sweep_duration() -> Histogram:
+    """Wall time of one batched consolidation sweep (arena build included
+    on a miss), split by phase: `prefix` (all-prefix delete probe) vs
+    `single` (per-candidate replacement screen)."""
+    return REGISTRY.histogram(
+        "karpenter_disruption_sweep_duration_seconds",
+        "Duration of one batched consolidation sweep phase.",
+        labels=("phase",),
+        buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5, 15))
+
+
+def disruption_sweep_probes() -> Gauge:
+    """Aggregate device solves the last consolidation tick issued — the
+    number the batched sweep holds at ≤3 where the sequential path paid
+    ~log₂N + 2N."""
+    return REGISTRY.gauge(
+        "karpenter_disruption_sweep_device_calls",
+        "Aggregate kernel calls in the last consolidation evaluation.")
+
+
+def disruption_arena_requests() -> Counter:
+    """Simulation-arena cache traffic: `hit` (fingerprint unchanged, tensors
+    reused) vs `build` (cluster changed, re-tensorized)."""
+    return REGISTRY.counter(
+        "karpenter_disruption_arena_requests_total",
+        "Simulation arena lookups by outcome.",
+        labels=("outcome",))
+
+
+def disruption_candidates_truncated() -> Counter:
+    """Candidates dropped by the max_candidates discovery cap — nonzero
+    means 'swept everything' is NOT true for this cluster (no-silent-caps)."""
+    return REGISTRY.counter(
+        "karpenter_disruption_candidates_truncated_total",
+        "Disruption candidates dropped by the max_candidates cap.")
+
+
 def nodepool_usage() -> Gauge:
     """Per-pool resource usage (karpenter_nodepool_usage)."""
     return REGISTRY.gauge(

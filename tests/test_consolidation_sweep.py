@@ -1,0 +1,254 @@
+"""Batched consolidation sweep parity: the arena's one-shot prefix/single
+probing must return the verdicts the sequential per-probe `simulate` oracle
+returns, and the controller's chosen actions must be unchanged — including
+composed PDB budgets over prefix unions and the decode-audit rejection
+fallback (ISSUE 2 satellite: sweep parity property tests)."""
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (Disruption, NodePool,
+                                       PodDisruptionBudget)
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.controllers.disruption import DisruptionController
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import metrics
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+
+
+def env(catalog=None, pools=None, batched=True):
+    clock = FakeClock()
+    cloud = FakeCloud(clock)
+    provider = CloudProvider(cloud, catalog or small_catalog(), clock=clock)
+    cluster = Cluster(clock)
+    pools = pools or [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized"))]
+    prov = Provisioner(provider, cluster, pools, clock=clock)
+    ctrl = DisruptionController(provider, cluster, pools, clock=clock,
+                                stabilization_s=0.0, batched_sweep=batched)
+    return clock, cloud, provider, cluster, prov, ctrl
+
+
+def provision(cluster, prov, pods):
+    cluster.add_pods(pods)
+    res = prov.provision()
+    assert not res.unschedulable
+    return res
+
+
+def build_underutilized(cluster, prov, rng, n_groups=5):
+    """Random fleet, then random pod deletions → a consolidatable mess."""
+    for _ in range(n_groups):
+        k = int(rng.integers(1, 4))
+        pods = [cpu_pod(cpu_m=int(rng.integers(200, 1800)),
+                        mem_mib=int(rng.integers(256, 3000)))
+                for _ in range(k)]
+        provision(cluster, prov, pods)
+    all_pods = list(cluster.pods.values())
+    rng.shuffle(all_pods)
+    for p in all_pods[:int(len(all_pods) * 0.6)]:
+        cluster.delete_pod(p)
+
+
+def action_signature(action):
+    """What 'the same action' means: kind + candidate nodes + what gets
+    launched (instance types, sorted)."""
+    if action is None:
+        return None
+    launched = []
+    if action.simulation is not None:
+        launched = sorted(d.option.instance_type
+                          for d in action.simulation.nodes)
+    return (action.kind, [c.name for c in action.candidates], launched)
+
+
+# ---------------------------------------------------------------------------
+# row-level verdict parity: sweep rows vs per-probe simulate
+# ---------------------------------------------------------------------------
+
+def test_prefix_sweep_rows_match_sequential_probes():
+    rng = np.random.default_rng(7)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng)
+    cands = ctrl.candidates()
+    assert len(cands) >= 2
+    arena = ctrl._arena_for(cands)
+    sweep = arena.sweep_prefixes()
+    for k in range(1, len(cands) + 1):
+        _, result, _ = ctrl.simulate(cands[:k], allow_new=False, decode=False)
+        assert int(sweep.unschedulable[k - 1]) == len(result.unschedulable), \
+            f"prefix {k}: batched unsched != sequential"
+        assert int(sweep.new_nodes[k - 1]) == len(result.nodes)
+        seq_feasible = not result.unschedulable and not result.nodes
+        assert sweep.feasible_delete(k - 1) == seq_feasible
+
+
+def test_single_sweep_rows_match_sequential_screens():
+    rng = np.random.default_rng(11)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng)
+    cands = ctrl.candidates()
+    assert len(cands) >= 2
+    arena = ctrl._arena_for(cands)
+    screen = arena.sweep_singles()
+    for i, c in enumerate(cands):
+        if not c.reschedulable:
+            continue
+        _, result, _ = ctrl.simulate([c], allow_new=True,
+                                     max_total_price=c.price, decode=False)
+        assert int(screen.unschedulable[i]) == len(result.unschedulable), \
+            f"candidate {c.name}: batched unsched != sequential"
+        assert int(screen.new_nodes[i]) == len(result.nodes)
+        assert screen.total_price[i] == pytest.approx(result.total_price,
+                                                      abs=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# action-level parity: batched controller vs sequential controller
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_consolidation_action_parity_randomized(seed):
+    rng = np.random.default_rng(seed)
+    catalog = [make_type("a.small", 2, 4, 0.10),
+               make_type("a.medium", 4, 8, 0.20),
+               make_type("a.large", 8, 16, 0.40),
+               make_type("s.small", 2, 4, 0.12, spot_discount=0.4)]
+    clock, cloud, provider, cluster, prov, ctrl_b = env(catalog=catalog)
+    build_underutilized(cluster, prov, rng)
+    ctrl_s = DisruptionController(provider, cluster, ctrl_b.nodepools,
+                                  clock=clock, stabilization_s=0.0,
+                                  batched_sweep=False)
+    cands_b = ctrl_b.candidates()
+    cands_s = ctrl_s.candidates()
+    assert [c.name for c in cands_b] == [c.name for c in cands_s]
+    a_b = ctrl_b.consolidation_action(cands_b)
+    a_s = ctrl_s.consolidation_action(cands_s)
+    assert action_signature(a_b) == action_signature(a_s)
+
+
+def test_pdb_union_budgets_compose_identically():
+    """Per-node PDB checks pass but the union must fail at some prefix:
+    the incremental prefix evictability and the batched verdicts must agree
+    with the sequential oracle."""
+    zones = ("zone-a", "zone-b", "zone-c")
+    catalog = [make_type("a.small", 2, 4, 0.10, zones=zones),
+               make_type("a.large", 8, 16, 0.40, zones=zones)]
+    clock, cloud, provider, cluster, prov, ctrl_b = env(catalog=catalog)
+    anchor = cpu_pod(cpu_m=6000, mem_mib=8000)
+    provision(cluster, prov, [anchor])
+    web = [cpu_pod(cpu_m=1500, mem_mib=2000, labels={"app": "web"},
+                   node_selector={wk.ZONE: z}) for z in ("zone-b", "zone-c")]
+    provision(cluster, prov, web)
+    cluster.add_pdb(PodDisruptionBudget(selector={"app": "web"},
+                                        max_unavailable=1))
+    ctrl_s = DisruptionController(provider, cluster, ctrl_b.nodepools,
+                                  clock=clock, stabilization_s=0.0,
+                                  batched_sweep=False)
+    cands = ctrl_b.candidates()
+    assert len(cands) >= 2
+    # incremental prefix evictability == the composed evictable() oracle
+    evict_ok = ctrl_b._prefix_evictable(cands)
+    for k in range(len(cands) + 1):
+        union = [p for c in cands[:k] for p in c.reschedulable]
+        assert evict_ok[k] == cluster.evictable(union), f"prefix {k}"
+    a_b = ctrl_b.consolidation_action(cands)
+    a_s = ctrl_s.consolidation_action(ctrl_s.candidates())
+    assert action_signature(a_b) == action_signature(a_s)
+    if a_b is not None:
+        evicted = [p for c in a_b.candidates for p in c.reschedulable
+                   if p.labels.get("app") == "web"]
+        assert len(evicted) <= 1
+
+
+def test_decode_audit_rejection_parity(monkeypatch):
+    """When the batch-topology audit rejects the aggregate winner, both
+    paths must fall back identically (decoded binary search over the
+    remaining range)."""
+    rng = np.random.default_rng(3)
+    clock, cloud, provider, cluster, prov, ctrl_b = env()
+    build_underutilized(cluster, prov, rng)
+    ctrl_s = DisruptionController(provider, cluster, ctrl_b.nodepools,
+                                  clock=clock, stabilization_s=0.0,
+                                  batched_sweep=False)
+    from karpenter_tpu.controllers import disruption as dmod
+
+    def reject_big(problem, result, node_list):
+        # deterministically reject any decoded solve rescheduling >= 3 pods:
+        # the largest feasible prefix fails its audit, smaller ones pass
+        if len(problem.pods) >= 3:
+            return {0}
+        return set()
+
+    monkeypatch.setattr(dmod, "find_batch_topology_violations", reject_big)
+    cands = ctrl_b.candidates()
+    a_b = ctrl_b.consolidation_action(cands)
+    a_s = ctrl_s.consolidation_action(ctrl_s.candidates())
+    assert action_signature(a_b) == action_signature(a_s)
+    if a_b is not None and a_b.kind == "delete":
+        # the audit held: the accepted action reschedules < 3 pods
+        assert sum(len(c.reschedulable) for c in a_b.candidates) < 3
+
+
+# ---------------------------------------------------------------------------
+# arena caching + probe accounting + truncation
+# ---------------------------------------------------------------------------
+
+def test_arena_cache_hits_within_tick_and_across_unchanged_ticks():
+    rng = np.random.default_rng(5)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng)
+    hits = metrics.disruption_arena_requests()
+    h0 = hits.value({"outcome": "hit"})
+    b0 = hits.value({"outcome": "build"})
+    cands = ctrl.candidates()
+    a1 = ctrl._arena_for(cands)
+    a2 = ctrl._arena_for(cands)
+    assert a2 is a1                       # unchanged cluster → cached arena
+    assert hits.value({"outcome": "build"}) == b0 + 1
+    assert hits.value({"outcome": "hit"}) == h0 + 1
+    # any pod churn invalidates the fingerprint
+    victim = next(p for p in cluster.pods.values())
+    cluster.delete_pod(victim)
+    a3 = ctrl._arena_for(ctrl.candidates())
+    assert a3 is not a1
+    assert hits.value({"outcome": "build"}) == b0 + 2
+
+
+def test_sweep_issues_bounded_device_calls():
+    """≤ 3 aggregate device solves per consolidation evaluation — the
+    sequential path paid ~log₂N + 2N."""
+    rng = np.random.default_rng(9)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng, n_groups=6)
+    cands = ctrl.candidates()
+    assert len(cands) >= 3
+    ctrl.consolidation_action(cands)
+    assert metrics.disruption_sweep_probes().value() <= 3
+
+
+def test_candidate_truncation_counted_and_logged(caplog):
+    import logging
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    for _ in range(4):
+        provision(cluster, prov, [cpu_pod(cpu_m=1800, mem_mib=3500)])
+    ctrl.max_candidates = 2
+    before = metrics.disruption_candidates_truncated().value()
+    with caplog.at_level(logging.INFO, logger="karpenter_tpu.disruption"):
+        cands = ctrl.candidates()
+    assert len(cands) == 2
+    assert metrics.disruption_candidates_truncated().value() == before + 2
+    assert any("truncated" in r.message for r in caplog.records)
