@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test benchmark bench-smoke bench-consolidation benchmark-interruption deflake native clean help
+.PHONY: test scale-test benchmark bench-smoke bench-consolidation benchmark-interruption trace-demo deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -24,6 +24,9 @@ bench-consolidation: ## Consolidation-replay configs only (sweep + sequential ba
 
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py
+
+trace-demo: ## Provision + consolidate in-memory, pretty-print /debug/traces (docs/tracing.md)
+	JAX_PLATFORMS=cpu python -m karpenter_tpu.tools.trace_demo
 
 deflake: ## Run the suite 5x to shake out order/timing flakes (Makefile:106-109)
 	for i in 1 2 3 4 5; do $(PYTEST) tests/ -q -p no:randomly || exit 1; done

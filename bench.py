@@ -136,8 +136,77 @@ def time_solve(pods, catalog, pools, iters=5, cold=False):
         r = solve_classpack(prob)
         e2e.append((time.perf_counter() - t0) * 1000)
         t_solve.append((time.perf_counter() - t1) * 1000)
+    trace_stats = _trace_passes(pods, catalog, pools, iters)
     return (float(np.median(e2e)), float(np.median(t_solve)), r, prob,
-            cold_ms, stale_ms)
+            cold_ms, stale_ms, trace_stats)
+
+
+_PHASE_KEYS = {"solve.tensorize": "tensorize", "solve.pack": "solve",
+               "solve.kernel": "kernel", "solve.decode": "decode",
+               "sweep.arena": "arena", "sweep.prefix": "prefix",
+               "sweep.decode": "action_decode", "sweep.single": "single"}
+
+
+def _phase_stats(durations, prefix="phase"):
+    out = {}
+    for name, vals in sorted(durations.items()):
+        key = _PHASE_KEYS.get(name, name.split(".", 1)[-1])
+        out[f"{prefix}_{key}_p50_ms"] = round(float(np.percentile(vals, 50)), 3)
+        out[f"{prefix}_{key}_p95_ms"] = round(float(np.percentile(vals, 95)), 3)
+    return out
+
+
+def _collect_phases(node, into):
+    into.setdefault(node["name"], []).append(node["duration_ms"])
+    for c in node.get("children", ()):
+        _collect_phases(c, into)
+
+
+def _trace_passes(pods, catalog, pools, iters):
+    """Two extra warm passes over the product path: one with the tracer
+    hard-disabled, one under a `bench.tick` root span (the instrumented
+    solve_classpack contributes kernel/decode children and device-call
+    annotations).  Yields the per-phase p50/p95 breakdown plus the tracer
+    overhead number (traced p50 vs untraced p50 — acceptance: < 2%)."""
+    from karpenter_tpu.ops.classpack import solve_classpack
+    from karpenter_tpu.ops.tensorize import tensorize
+    from karpenter_tpu.utils import tracing
+    tr = tracing.TRACER
+    prev_enabled, prev_slow = tr.enabled, tr.slow_ms
+    tr.slow_ms = 0.0
+    tr.reset()
+    # interleave traced/untraced ticks so clock drift and cache effects
+    # land on both sides equally; the raw span machinery costs ~60us/tick
+    # so a handful of ms-scale samples per side resolves it
+    n = max(iters, 15)
+    off, on = [], []
+    for i in range(2 * n):
+        traced = bool(i & 1)
+        tr.enabled = traced
+        t0 = time.perf_counter()
+        if traced:
+            with tr.span("bench.tick"):
+                with tr.span("solve.tensorize"):
+                    prob = tensorize(pods, catalog, pools)
+                with tr.span("solve.pack"):
+                    solve_classpack(prob)
+            on.append((time.perf_counter() - t0) * 1000)
+        else:
+            solve_classpack(tensorize(pods, catalog, pools))
+            off.append((time.perf_counter() - t0) * 1000)
+    tr.enabled = True
+    durations: dict = {}
+    for t in tr.traces():
+        if t["name"] == "bench.tick":
+            for c in t["children"]:
+                _collect_phases(c, durations)
+    off_p50, on_p50 = float(np.median(off)), float(np.median(on))
+    stats = _phase_stats(durations)
+    stats["trace_overhead_pct"] = (
+        round(100.0 * (on_p50 - off_p50) / off_p50, 3) if off_p50 > 0
+        else None)
+    tr.enabled, tr.slow_ms = prev_enabled, prev_slow
+    return stats
 
 
 def cost_lower_bound(prob):
@@ -158,7 +227,7 @@ def run_config(name, pods, n_types, pools=None, iters=5, cold=False):
 
     catalog = generate_catalog(n_types)
     pools = pools or [NodePool()]
-    e2e_p50, solve_p50, r, prob, cold_ms, stale_ms = time_solve(
+    e2e_p50, solve_p50, r, prob, cold_ms, stale_ms, trace_stats = time_solve(
         pods, catalog, pools, iters, cold=cold)
     lb = cost_lower_bound(prob)
     ratio = (r.total_price / lb) if lb > 0 else float("nan")
@@ -169,7 +238,9 @@ def run_config(name, pods, n_types, pools=None, iters=5, cold=False):
         f"(solve+decode={solve_p50:.1f}ms) nodes={len(r.nodes)} "
         f"cost=${r.total_price:.2f}/h (lb ${lb:.2f}, x{ratio:.3f}) "
         f"unsched={len(r.unschedulable)}")
-    return e2e_p50, solve_p50, cold_ms, stale_ms
+    log(f"[{name}] phases: " + " ".join(
+        f"{k}={v}" for k, v in sorted(trace_stats.items())))
+    return e2e_p50, solve_p50, cold_ms, stale_ms, trace_stats
 
 
 def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
@@ -241,6 +312,7 @@ def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
     out = {"simulate_p50_ms": round(p50, 2),
            "probe_p50_ms": round(probe_p50, 2)}
 
+    from karpenter_tpu.utils import tracing
     clock = lambda: time.time() + 10_000
     for n_c in sweep_shapes:
         ctrl_b = DisruptionController(provider, cluster, pools, clock=clock,
@@ -249,20 +321,33 @@ def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
         t0 = time.perf_counter()
         ctrl_b.consolidation_action(cands_b)
         cold_ms = (time.perf_counter() - t0) * 1000
+        # warm passes run under a bench.sweep root so the controller's
+        # sweep.arena/prefix/decode/single spans land in one trace per tick
+        tracing.TRACER.reset()
         warm = []
         for _ in range(iters):
             t0 = time.perf_counter()
-            action = ctrl_b.consolidation_action(cands_b)
+            with tracing.span("bench.sweep"):
+                action = ctrl_b.consolidation_action(cands_b)
             warm.append((time.perf_counter() - t0) * 1000)
+        durations: dict = {}
+        for t in tracing.TRACER.traces():
+            if t["name"] == "bench.sweep":
+                for c in t["children"]:
+                    _collect_phases(c, durations)
+        phases = _phase_stats(durations, prefix=f"sweep_{n_c}")
         sweep_p50 = float(np.median(warm))
         calls = int(metrics.disruption_sweep_probes().value())
         log(f"[consolidation-sweep-{n_c}] candidates={len(cands_b)} "
             f"cold={cold_ms:.1f}ms warm_p50={sweep_p50:.1f}ms "
             f"device_calls={calls} "
             f"action={'none' if action is None else action.name}")
+        log(f"[consolidation-sweep-{n_c}] phases: " + " ".join(
+            f"{k}={v}" for k, v in sorted(phases.items())))
         out[f"sweep_p50_ms_{n_c}"] = round(sweep_p50, 2)
         out[f"sweep_cold_ms_{n_c}"] = round(cold_ms, 2)
         out[f"probes_per_tick_{n_c}"] = calls
+        out.update(phases)
 
     # sequential baseline (the pre-arena algorithm) at the 100-candidate
     # shape — one evaluation is ~log2(N) probes each paying lower+tensorize
@@ -395,15 +480,17 @@ def run_all(smoke=False, consolidation=False):
     if smoke:
         # `make bench-smoke`: the 1k-homogeneous config only — a fast
         # end-to-end sanity pass over the product path and JSON contract
-        p50, _solve_p50, _, _ = run_config(
+        p50, _solve_p50, _, _, tstats = run_config(
             "1k-homogeneous", build_pods(1, 1000, rng), 10, iters=3)
-        print(json.dumps({
+        smoke_tail = {
             "metric": "1k-pod x 10-type end-to-end schedule (smoke) p50 latency",
             "value": round(p50, 2),
             "unit": "ms",
             "platform": platform,
             "fallback": fallback,
-        }), flush=True)
+        }
+        smoke_tail.update(tstats)
+        print(json.dumps(smoke_tail), flush=True)
         return
 
     # config 1: 1k homogeneous CPU pods, 10 types
@@ -411,7 +498,7 @@ def run_all(smoke=False, consolidation=False):
     # config 2: 10k mixed pods, 200 types — with the cold/stale/warm cache
     # split (cold tick = refinery-backed greedy answer; stale = rescaled
     # previous guide; warm = refined LP guide)
-    warm10_p50, _s10, cold10_p50, stale10_p50 = run_config(
+    warm10_p50, _s10, cold10_p50, stale10_p50, _t10 = run_config(
         "10k-mixed", build_pods(100, 10_000, rng, zone_frac=0.3), 200,
         iters=3, cold=True)
     # config 3: 5k GPU pods
@@ -425,8 +512,8 @@ def run_all(smoke=False, consolidation=False):
     # 1-2 per burst, so a wider sample keeps the p50 on the true latency)
     headline_pods = build_pods(200, 50_000, rng, gpu_frac=0.05, zone_frac=0.2,
                                taint_frac=0.1)
-    p50, _solve_p50, _, _ = run_config("50k-burst", headline_pods, 600,
-                                       iters=9)
+    p50, _solve_p50, _, _, tstats = run_config("50k-burst", headline_pods, 600,
+                                               iters=9)
 
     baseline_ms = 200.0
     tail = {
@@ -440,6 +527,7 @@ def run_all(smoke=False, consolidation=False):
         "warm_p50_ms_10k": round(warm10_p50, 2),
         "fallback": fallback,
     }
+    tail.update(tstats)
     tail.update({f"consolidation_{k}": v for k, v in cons.items()})
     print(json.dumps(tail), flush=True)
 

@@ -13,13 +13,14 @@ import signal
 import sys
 
 from .operator import ControllerManager, Operator, Options, build_controllers
+from .utils.tracing import configure_logging
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    # options first: the log handler (text vs json, slow-span threshold)
+    # is itself configured by flags/env
     options = Options.from_args(argv)
+    configure_logging(options)
     op = Operator(options)
     manager = ControllerManager(op, build_controllers(op))
     port = manager.serve_endpoints()

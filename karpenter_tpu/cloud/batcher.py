@@ -157,16 +157,22 @@ class Batcher(Generic[Req, Res]):
         self._run(bucket)
 
     def _run(self, bucket: _Bucket) -> None:
-        try:
-            results = list(self.options.batch_executor(list(bucket.requests)))
-            if len(results) != len(bucket.requests):
-                raise RuntimeError(
-                    f"batcher {self.options.name}: executor returned "
-                    f"{len(results)} results for {len(bucket.requests)} requests")
-            error = None
-        except BaseException as e:  # fan the failure back to every caller
-            results, error = None, e
-        window = self.clock() - bucket.opened
+        # flusher threads are their own trace roots: a flush belongs to the
+        # window, not to any single caller's tick
+        from ..utils import tracing
+        with tracing.span("batcher.flush", batcher=self.options.name,
+                          size=len(bucket.requests)) as sp:
+            try:
+                results = list(self.options.batch_executor(list(bucket.requests)))
+                if len(results) != len(bucket.requests):
+                    raise RuntimeError(
+                        f"batcher {self.options.name}: executor returned "
+                        f"{len(results)} results for {len(bucket.requests)} requests")
+                error = None
+            except BaseException as e:  # fan the failure back to every caller
+                results, error = None, e
+            window = self.clock() - bucket.opened
+            sp.annotate(window_s=round(window, 4), error=bool(error))
         # shared stats guarded by the batcher lock, not the per-bucket one —
         # concurrent buckets flush in parallel
         with self._lock:

@@ -47,7 +47,7 @@ from ..ops.constraints import (LEVEL_REQUIRED_ONLY,
 from ..ops.ffd import PackingResult
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.events import Event
 
 log = logging.getLogger("karpenter_tpu.disruption")
@@ -397,37 +397,52 @@ class DisruptionController:
     # the single-action reconcile
     # ------------------------------------------------------------------
     def reconcile(self) -> DisruptionResult:
+        with tracing.span("disruption.reconcile") as sp:
+            out = self._reconcile()
+            sp.annotate(
+                action=getattr(out.action, "name", "") if out.action else "",
+                deleted=len(out.deleted), launched=len(out.launched))
+            return out
+
+    def _reconcile(self) -> DisruptionResult:
         eval_hist = metrics.disruption_evaluation_duration()
         eligible = metrics.disruption_eligible_nodes()
-        cands = self.candidates()
-        # per-method eligibility gauges, all computed up-front so no series
-        # goes stale when an earlier method short-circuits the tick (calling
-        # find_empty every tick also keeps its empty-since timers fresh)
-        expired = self.find_expired(cands)
-        drifted = self.find_drifted(cands)
-        empty = self.find_empty(cands)
-        underutil = [c for c in cands
-                     if c.pool.disruption.consolidation_policy == "WhenUnderutilized"]
-        eligible.set(len(expired), {"method": "expiration"})
-        eligible.set(len(drifted), {"method": "drift"})
-        eligible.set(len(empty), {"method": "emptiness"})
-        eligible.set(len(underutil), {"method": "consolidation"})
+        with tracing.span("disruption.candidates") as csp:
+            cands = self.candidates()
+            # per-method eligibility gauges, all computed up-front so no
+            # series goes stale when an earlier method short-circuits the
+            # tick (calling find_empty every tick also keeps its empty-since
+            # timers fresh)
+            expired = self.find_expired(cands)
+            drifted = self.find_drifted(cands)
+            empty = self.find_empty(cands)
+            underutil = [c for c in cands
+                         if c.pool.disruption.consolidation_policy ==
+                         "WhenUnderutilized"]
+            eligible.set(len(expired), {"method": "expiration"})
+            eligible.set(len(drifted), {"method": "drift"})
+            eligible.set(len(empty), {"method": "emptiness"})
+            eligible.set(len(underutil), {"method": "consolidation"})
+            csp.annotate(candidates=len(cands), expired=len(expired),
+                         drifted=len(drifted), empty=len(empty))
         if not cands:
             return DisruptionResult()
 
         def timed(method, fn):
-            t0 = time.perf_counter()
-            try:
-                return fn()
-            finally:
-                dt = time.perf_counter() - t0
-                eval_hist.observe(dt, {"method": method})
-                # the reference aborts a consolidation pass at its 1-minute
-                # budget and counts it; the batched simulator stays ~3
-                # orders of magnitude under that, so the counter exists to
-                # prove the budget is honored, not because it ever fires
-                if dt > CONSOLIDATION_TIMEOUT_S:
-                    metrics.consolidation_timeouts().inc({"method": method})
+            with tracing.span(f"disruption.{method}"):
+                t0 = time.perf_counter()
+                try:
+                    return fn()
+                finally:
+                    dt = time.perf_counter() - t0
+                    eval_hist.observe(dt, {"method": method})
+                    # the reference aborts a consolidation pass at its
+                    # 1-minute budget and counts it; the batched simulator
+                    # stays ~3 orders of magnitude under that, so the
+                    # counter exists to prove the budget is honored, not
+                    # because it ever fires
+                    if dt > CONSOLIDATION_TIMEOUT_S:
+                        metrics.consolidation_timeouts().inc({"method": method})
 
         # 1. expiration (graceful replace: pods rescheduled, new capacity allowed)
         if expired:
@@ -489,7 +504,8 @@ class DisruptionController:
 
         sweep_hist = metrics.disruption_sweep_duration()
         t0 = time.perf_counter()
-        arena = self._arena_for(cands)
+        with tracing.span("sweep.arena", candidates=len(cands)):
+            arena = self._arena_for(cands)
         # PDB composition over prefix unions, computed incrementally on the
         # host in ONE pass (the sequential path rebuilt the union and
         # rescanned every PDB per binary-search step)
@@ -505,40 +521,44 @@ class DisruptionController:
         device_calls = 0
         feas: Dict[int, bool] = {}
         lo, hi, best_mid = 1, len(cands), 0
-        while lo <= hi:
-            mids = _search_frontier(lo, hi)
-            need = [k for k in mids if k not in feas]
-            if need:
-                sweep = arena.sweep_prefix_subset(need)
-                device_calls += sweep.device_calls
-                for i, k in enumerate(need):
-                    feas[k] = evict_ok[k] and sweep.feasible_delete(i)
+        with tracing.span("sweep.prefix") as psp:
             while lo <= hi:
-                mid = (lo + hi) // 2
-                if mid not in feas:
-                    break
-                if feas[mid]:
-                    best_mid = mid
-                    lo = mid + 1
-                else:
-                    hi = mid - 1
+                mids = _search_frontier(lo, hi)
+                need = [k for k in mids if k not in feas]
+                if need:
+                    sweep = arena.sweep_prefix_subset(need)
+                    device_calls += sweep.device_calls
+                    for i, k in enumerate(need):
+                        feas[k] = evict_ok[k] and sweep.feasible_delete(i)
+                while lo <= hi:
+                    mid = (lo + hi) // 2
+                    if mid not in feas:
+                        break
+                    if feas[mid]:
+                        best_mid = mid
+                        lo = mid + 1
+                    else:
+                        hi = mid - 1
+            psp.annotate(device_calls=device_calls, best_mid=best_mid)
         sweep_hist.observe(time.perf_counter() - t0, {"phase": "prefix"})
         # the aggregate probe is optimistic about intra-batch topology
         # (spread/anti-affinity audits need assignments): decode the winner
         # — common case, ONE decoded solve total.  If the audit rejects it,
         # rerun the binary search with decoded probes over the remaining
         # range: the pre-probe algorithm, paid only when audits bite.
-        best = self._decoded_delete_action(cands[:best_mid]) if best_mid else None
-        if best is None and best_mid > 1:
-            lo, hi = 1, best_mid - 1
-            while lo <= hi:
-                mid = (lo + hi) // 2
-                a = self._decoded_delete_action(cands[:mid])
-                if a is not None:
-                    best = a
-                    lo = mid + 1
-                else:
-                    hi = mid - 1
+        with tracing.span("sweep.decode", best_mid=best_mid) as dsp:
+            best = self._decoded_delete_action(cands[:best_mid]) if best_mid else None
+            if best is None and best_mid > 1:
+                dsp.annotate(audit_rejected=True)
+                lo, hi = 1, best_mid - 1
+                while lo <= hi:
+                    mid = (lo + hi) // 2
+                    a = self._decoded_delete_action(cands[:mid])
+                    if a is not None:
+                        best = a
+                        lo = mid + 1
+                    else:
+                        hi = mid - 1
         if best is not None:
             metrics.disruption_sweep_probes().set(device_calls)
             return best
@@ -548,21 +568,23 @@ class DisruptionController:
         # decoded accept path candidate-by-candidate in discovery order —
         # first acceptance wins, exactly like the sequential loop.
         t1 = time.perf_counter()
-        screen = arena.sweep_singles()
-        sweep_hist.observe(time.perf_counter() - t1, {"phase": "single"})
-        device_calls += screen.device_calls
-        metrics.disruption_sweep_probes().set(device_calls)
-        for i, c in enumerate(cands):
-            if not c.reschedulable:
-                continue
-            if screen.unschedulable[i] or screen.new_nodes[i] > 1:
-                continue
-            if screen.new_nodes[i] and screen.total_price[i] >= c.price:
-                continue
-            action = self._decoded_single_action(c)
-            if action is not None:
-                return action
-        return None
+        with tracing.span("sweep.single") as ssp:
+            screen = arena.sweep_singles()
+            sweep_hist.observe(time.perf_counter() - t1, {"phase": "single"})
+            device_calls += screen.device_calls
+            ssp.annotate(device_calls=screen.device_calls)
+            metrics.disruption_sweep_probes().set(device_calls)
+            for i, c in enumerate(cands):
+                if not c.reschedulable:
+                    continue
+                if screen.unschedulable[i] or screen.new_nodes[i] > 1:
+                    continue
+                if screen.new_nodes[i] and screen.total_price[i] >= c.price:
+                    continue
+                action = self._decoded_single_action(c)
+                if action is not None:
+                    return action
+            return None
 
     def _arena_for(self, cands: List[Candidate]):
         """Size-1 simulation-arena cache keyed on the cluster-state
@@ -580,11 +602,13 @@ class DisruptionController:
         cached = self._arena_cache
         if cached is not None and cached[0] == key:
             metrics.disruption_arena_requests().inc({"outcome": "hit"})
+            tracing.annotate(arena="hit")
             return cached[1]
         arena = SimulationArena(cands, self.cluster, catalog, pools,
                                 node_classes=ncs)
         self._arena_cache = (key, arena)
         metrics.disruption_arena_requests().inc({"outcome": "build"})
+        tracing.annotate(arena="build")
         return arena
 
     def _prefix_evictable(self, cands: List[Candidate]) -> List[bool]:
@@ -729,6 +753,13 @@ class DisruptionController:
     # execution: taint → pre-spin replacements → rebind → terminate
     # ------------------------------------------------------------------
     def execute(self, action: Action) -> DisruptionResult:
+        with tracing.span("disruption.execute", kind=action.kind,
+                          reason=action.reason) as sp:
+            out = self._execute(action)
+            sp.annotate(deleted=len(out.deleted), launched=len(out.launched))
+            return out
+
+    def _execute(self, action: Action) -> DisruptionResult:
         out = DisruptionResult(action=action)
         # taint first so nothing new schedules onto doomed nodes
         # (website/.../concepts/disruption.md:9-14)

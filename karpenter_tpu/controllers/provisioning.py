@@ -37,7 +37,10 @@ from ..ops.ffd import (NATIVE_CUTOVER_ROWS, NodeDecision, PackingResult,
                        solve_ffd)
 from ..ops.tensorize import Problem, tensorize
 from ..state.cluster import Cluster
-from ..utils import metrics
+from ..utils import metrics, tracing
+from ..utils.events import Event
+from ..utils.provenance import (CAPACITY, ProvenanceRecord,
+                                explain_unschedulable)
 
 log = logging.getLogger("karpenter_tpu.provisioning")
 
@@ -102,11 +105,17 @@ class Provisioner:
                  max_nodes_per_round: int = 2048,
                  solver: str = "auto",
                  lp_guide: bool = True,
-                 refinery=None):
+                 refinery=None,
+                 recorder=None,
+                 provenance=None):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
         self.clock = clock
+        # decision provenance: Warning events through the recorder plus the
+        # queryable store behind /debug/pods/<name> (utils/provenance.py)
+        self.recorder = recorder
+        self.provenance = provenance
         self.max_nodes_per_round = max_nodes_per_round
         self.solver = solver
         # the LPGuide feature gate: False routes classpack solves straight
@@ -213,25 +222,37 @@ class Provisioner:
         zone_feasible = make_zone_feasibility(catalog, node_view)
         best = None
         for level in range(MAX_LEVEL + 1):
-            lowered = lower_pods(pods, nodes=node_view,
-                                 option_zones=zones, zone_rank=zone_rank,
-                                 level=level, zone_feasible=zone_feasible)
-            problem = tensorize(lowered, catalog, pools,
-                                node_classes=getattr(self.provider,
-                                                     "node_classes", None))
-            if schedule_on_existing and node_view:
-                node_list, alloc, used, compat = self.cluster.tensorize_nodes(
-                    problem.class_reps, problem.axes, scales=problem.scales,
-                    nodes=node_view)
-                solve = self._pick_solver(problem, n_existing=len(node_list))
-                result = solve(problem, max_nodes=self.max_nodes_per_round,
-                               existing_alloc=alloc, existing_used=used,
-                               existing_compat=compat)
-                result._existing_nodes = node_list
-            else:
-                solve = self._pick_solver(problem)
-                result = solve(problem, max_nodes=self.max_nodes_per_round)
-                result._existing_nodes = []
+            with tracing.span("solve.tensorize", level=level) as tsp:
+                lowered = lower_pods(pods, nodes=node_view,
+                                     option_zones=zones, zone_rank=zone_rank,
+                                     level=level, zone_feasible=zone_feasible)
+                problem = tensorize(lowered, catalog, pools,
+                                    node_classes=getattr(self.provider,
+                                                         "node_classes", None))
+                tsp.annotate(pods=len(pods), classes=problem.num_classes,
+                             options=problem.num_options)
+            with tracing.span("solve.pack", level=level) as psp:
+                if schedule_on_existing and node_view:
+                    node_list, alloc, used, compat = self.cluster.tensorize_nodes(
+                        problem.class_reps, problem.axes, scales=problem.scales,
+                        nodes=node_view)
+                    solve = self._pick_solver(problem, n_existing=len(node_list))
+                    psp.annotate(
+                        solver="ffd" if solve is solve_ffd else "classpack",
+                        rows=int(problem.class_counts.sum()) + len(node_list))
+                    result = solve(problem, max_nodes=self.max_nodes_per_round,
+                                   existing_alloc=alloc, existing_used=used,
+                                   existing_compat=compat)
+                    result._existing_nodes = node_list
+                else:
+                    solve = self._pick_solver(problem)
+                    psp.annotate(
+                        solver="ffd" if solve is solve_ffd else "classpack",
+                        rows=int(problem.class_counts.sum()))
+                    result = solve(problem, max_nodes=self.max_nodes_per_round)
+                    result._existing_nodes = []
+                psp.annotate(scheduled=result.scheduled_count,
+                             unschedulable=len(result.unschedulable))
             if best is None or result.scheduled_count > best[1].scheduled_count:
                 best = (problem, result)
             if not result.unschedulable or not soft:
@@ -249,6 +270,14 @@ class Provisioner:
         against the now-ICE-masked catalog (the reference reaches the same
         fixpoint via its retry-on-next-reconcile plus the launch-path retry
         at /root/reference/pkg/providers/instance/instance.go:96-100)."""
+        with tracing.span("provision") as root:
+            out = self._provision(pods, max_retries)
+            root.annotate(launched=len(out.launched), bound=out.scheduled,
+                          unschedulable=len(out.unschedulable),
+                          failed_launches=len(out.failed_launches))
+            return out
+
+    def _provision(self, pods, max_retries) -> ProvisioningResult:
         out = self._provision_once(pods)
         retries = 0
         while out.failed_launches and out.unschedulable and retries < max_retries:
@@ -291,6 +320,13 @@ class Provisioner:
         return out
 
     def _provision_once(self, pods: Optional[Sequence[Pod]] = None) -> ProvisioningResult:
+        with tracing.span("provision.round") as sp:
+            out = self._provision_round(pods)
+            sp.annotate(bound=out.scheduled,
+                        unschedulable=len(out.unschedulable))
+            return out
+
+    def _provision_round(self, pods: Optional[Sequence[Pod]] = None) -> ProvisioningResult:
         t0 = self.clock()
         out = ProvisioningResult()
         if pods is None:
@@ -302,65 +338,101 @@ class Provisioner:
             return out
         problem, packing = self.solve(pods)
         out.solve_seconds = self.clock() - t0
-        catalog_by_name = {it.name: it for it in self.provider.get_instance_types()}
 
-        orig = self.cluster.original
+        with tracing.span("provision.launch") as lsp:
+            catalog_by_name = {it.name: it
+                               for it in self.provider.get_instance_types()}
 
-        # batch-internal anti-affinity/spread the masks couldn't see: strand
-        # the violating carriers; they re-solve against bound targets
-        stranded = find_batch_topology_violations(
-            problem, packing, packing._existing_nodes)
-        out.stranded = [orig(problem.pods[i]) for i in stranded]
+            orig = self.cluster.original
 
-        # pods placed on existing nodes
-        for pod_i, slot in packing.existing_assignments.items():
-            if pod_i in stranded:
-                continue
-            node = packing._existing_nodes[slot]
-            self.cluster.bind_pod(orig(problem.pods[pod_i]), node.name)
-            out.bound_existing += 1
+            # batch-internal anti-affinity/spread the masks couldn't see:
+            # strand the violating carriers; they re-solve against bound
+            # targets
+            stranded = find_batch_topology_violations(
+                problem, packing, packing._existing_nodes)
+            out.stranded = [orig(problem.pods[i]) for i in stranded]
 
-        # new nodes
-        for decision in packing.nodes:
-            if stranded:
-                decision.pod_indices = [i for i in decision.pod_indices
-                                        if i not in stranded]
-                if not decision.pod_indices:
+            # pods placed on existing nodes
+            for pod_i, slot in packing.existing_assignments.items():
+                if pod_i in stranded:
                     continue
-            dpods = [orig(problem.pods[i]) for i in decision.pod_indices]
-            claim = claim_from_decision(decision, dpods, self.nodepools)
-            try:
-                claim = self.provider.create(claim)
-            except InsufficientCapacityError as e:
-                # leave pods pending; ICE cache updated inside create() so the
-                # next round solves against a corrected catalog. A missing
-                # nodeclass is a persistent config error, not capacity — log
-                # it at error so operators see it isn't self-healing.
-                if isinstance(e, NodeClassNotFoundError):
-                    log.error("launch blocked by configuration: %s", e)
-                else:
-                    log.warning("launch failed: %s", e)
-                out.failed_launches.append(str(e))
-                out.unschedulable.extend(dpods)
-                continue
-            it = catalog_by_name.get(claim.instance_type)
-            if it is not None:
-                ncs = getattr(self.provider, "node_classes", None) or {}
-                it = effective_instance_type(
-                    it, self.nodepools.get(claim.nodepool),
-                    ncs.get(claim.node_class_ref))
-            allocatable = it.allocatable if it else claim.requests
-            node = self.cluster.register_nodeclaim(claim, allocatable,
-                                                   it.capacity if it else None)
-            for p in dpods:
-                self.cluster.bind_pod(p, node.name)
-            out.bound_new += len(dpods)
-            out.launched.append(claim)
+                node = packing._existing_nodes[slot]
+                pod = orig(problem.pods[pod_i])
+                self.cluster.bind_pod(pod, node.name)
+                if self.provenance is not None:
+                    self.provenance.clear(pod.name)
+                out.bound_existing += 1
+
+            # new nodes
+            for decision in packing.nodes:
+                if stranded:
+                    decision.pod_indices = [i for i in decision.pod_indices
+                                            if i not in stranded]
+                    if not decision.pod_indices:
+                        continue
+                dpods = [orig(problem.pods[i]) for i in decision.pod_indices]
+                claim = claim_from_decision(decision, dpods, self.nodepools)
+                try:
+                    claim = self.provider.create(claim)
+                except InsufficientCapacityError as e:
+                    # leave pods pending; ICE cache updated inside create() so the
+                    # next round solves against a corrected catalog. A missing
+                    # nodeclass is a persistent config error, not capacity — log
+                    # it at error so operators see it isn't self-healing.
+                    if isinstance(e, NodeClassNotFoundError):
+                        log.error("launch blocked by configuration: %s", e)
+                    else:
+                        log.warning("launch failed: %s", e)
+                    out.failed_launches.append(str(e))
+                    out.unschedulable.extend(dpods)
+                    self._record_provenance(
+                        [ProvenanceRecord(pod=p.name, constraint=CAPACITY,
+                                          message=f"launch failed: {e}")
+                         for p in dpods])
+                    continue
+                it = catalog_by_name.get(claim.instance_type)
+                if it is not None:
+                    ncs = getattr(self.provider, "node_classes", None) or {}
+                    it = effective_instance_type(
+                        it, self.nodepools.get(claim.nodepool),
+                        ncs.get(claim.node_class_ref))
+                allocatable = it.allocatable if it else claim.requests
+                node = self.cluster.register_nodeclaim(claim, allocatable,
+                                                       it.capacity if it else None)
+                for p in dpods:
+                    self.cluster.bind_pod(p, node.name)
+                    if self.provenance is not None:
+                        self.provenance.clear(p.name)
+                out.bound_new += len(dpods)
+                out.launched.append(claim)
+            lsp.annotate(launched=len(out.launched),
+                         failed=len(out.failed_launches))
 
         out.unschedulable.extend(orig(problem.pods[i])
                                  for i in packing.unschedulable)
+        if packing.unschedulable and (self.provenance is not None
+                                      or self.recorder is not None):
+            with tracing.span("provision.provenance",
+                              pods=len(packing.unschedulable)):
+                self._record_provenance(
+                    [explain_unschedulable(problem, i)
+                     for i in packing.unschedulable])
         # scheduling-duration observability (karpenter_provisioner_* families,
         # metrics.md:146-149); the unschedulable gauge is set once per
         # provision() from the aggregated result, not per sub-round
         metrics.scheduling_duration().observe(out.solve_seconds)
         return out
+
+    def _record_provenance(self, records: Sequence[ProvenanceRecord]) -> None:
+        """Land unschedulability records in the queryable store and mirror
+        them as Warning events (the reference's FailedScheduling surface)."""
+        for rec in records:
+            if self.provenance is not None:
+                self.provenance.record(rec)
+            if self.recorder is not None:
+                self.recorder.publish(Event(
+                    kind="Pod", name=rec.pod, reason="FailedScheduling",
+                    message=(f"{rec.constraint}"
+                             + (f"/{rec.dimension}" if rec.dimension else "")
+                             + f": {rec.message}"),
+                    type="Warning"))

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .options import Options
 
 log = logging.getLogger("karpenter_tpu.manager")
@@ -279,6 +279,13 @@ class ControllerManager:
         strand and re-solve."""
         from ..api.serialize import pod_from_manifest
         from ..ops.constraints import find_batch_topology_violations
+        with tracing.span("http.solve") as _http_span:
+            return self._solve_request(payload, pod_from_manifest,
+                                       find_batch_topology_violations,
+                                       _http_span)
+
+    def _solve_request(self, payload, pod_from_manifest,
+                       find_batch_topology_violations, span) -> Dict:
         prov = self.controllers.get("provisioning")
         if prov is None:
             raise ValueError("no provisioning controller wired")
@@ -292,6 +299,7 @@ class ControllerManager:
             raise BadRequest(f"bad pod manifest: {e}") from e
         if not pods:
             raise BadRequest("no pods in request")
+        span.annotate(pods=len(pods))
         with self._state_lock:
             nodes = self.operator.cluster.snapshot_nodes()
             # pool limit filtering iterates live nodes and updates gauge
@@ -433,25 +441,61 @@ class ControllerManager:
             def log_message(self, *a):  # quiet
                 pass
 
+            def _json(self, payload, code=200):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+                url = urlparse(self.path)
                 if self.path == "/metrics":
                     body = metrics.REGISTRY.expose().encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path.startswith("/debug/pprof"):
+                elif url.path == "/debug/traces":
+                    # recent completed traces from the tracer ring buffer,
+                    # ?min_ms= filters out fast ones
+                    try:
+                        min_ms = float(
+                            parse_qs(url.query).get("min_ms", ["0"])[0])
+                    except ValueError:
+                        self._json({"error": "min_ms must be a number"}, 400)
+                        return
+                    self._json({"traces": tracing.TRACER.traces(min_ms)})
+                    return
+                elif url.path.startswith("/debug/pods/"):
+                    # per-pod scheduling provenance (why is this pod pending)
+                    name = url.path[len("/debug/pods/"):]
+                    store = getattr(manager.operator, "provenance", None)
+                    rec = store.get(name) if store is not None else None
+                    if rec is None:
+                        self._json({"error": f"no provenance for pod {name!r}"},
+                                   404)
+                        return
+                    self._json(rec.to_dict())
+                    return
+                elif url.path.startswith("/debug/pprof"):
                     # profiling surface behind --enable-profiling
-                    # (reference settings.md:23); all-thread stack dump
+                    # (reference settings.md:23): all-thread stack dump plus
+                    # a tracer ring-buffer snapshot, as JSON
                     if not manager.operator.options.enable_profiling:
-                        self.send_response(403)
-                        self.end_headers()
+                        self._json({"error": "profiling disabled; start with "
+                                             "--enable-profiling"}, 403)
                         return
                     import sys
                     import traceback
-                    lines = []
-                    for tid, frame in sys._current_frames().items():
-                        lines.append(f"--- thread {tid} ---")
-                        lines.extend(traceback.format_stack(frame))
-                    body = "".join(lines).encode()
-                    ctype = "text/plain"
+                    names = {t.ident: t.name for t in threading.enumerate()}
+                    threads = [
+                        {"thread_id": tid,
+                         "name": names.get(tid, ""),
+                         "frames": [ln.rstrip("\n") for ln in
+                                    traceback.format_stack(frame)]}
+                        for tid, frame in sys._current_frames().items()]
+                    self._json({"threads": threads,
+                                "traces": tracing.TRACER.traces()})
+                    return
                 elif self.path in ("/v1/nodepools", "/v1/nodeclasses"):
                     try:
                         out = manager.list_request(self.path.rsplit("/", 1)[1])

@@ -94,6 +94,14 @@ class Operator:
                 pass  # optional: bootstrap falls back to platform default
 
         self.recorder = Recorder(clock=clock)
+        # queryable "why is this pod pending" records (utils/provenance.py),
+        # served by the manager's /debug/pods/<name> endpoint
+        from ..utils.provenance import ProvenanceStore
+        self.provenance = ProvenanceStore()
+        # slow-span WARN threshold comes from --trace-slow-ms
+        from ..utils import tracing
+        tracing.TRACER.slow_ms = float(
+            getattr(self.options, "trace_slow_ms", 0.0) or 0.0)
         self.unavailable = UnavailableOfferings(clock=clock)
         self.subnets = SubnetProvider(self.cloud, clock=clock)
         self.security_groups = SecurityGroupProvider(self.cloud, clock=clock)
@@ -320,7 +328,9 @@ def build_controllers(op: Operator) -> Dict[str, object]:
     provisioner = Provisioner(
         op.cloud_provider, op.cluster, op.nodepools,
         lp_guide=op.options.gate("LPGuide"),
-        refinery=refinery)
+        refinery=refinery,
+        recorder=op.recorder,
+        provenance=op.provenance)
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {

@@ -46,6 +46,11 @@ class Options:
     health_port: int = DEFAULT_HEALTH_PORT
     leader_elect: bool = False
     enable_profiling: bool = False   # settings.md:23 --enable-profiling
+    # structured logging + tracing (utils/tracing.py): "text" keeps the
+    # classic line format, "json" emits one JSON object per line with
+    # trace/span ids; spans slower than trace_slow_ms log a WARN (0 = off)
+    log_format: str = "text"
+    trace_slow_ms: float = 0.0
     # LPGuide: the relaxed-LP fleet-mix guide in front of the pack kernel
     # (ops/lpguide.py) — on by default, an operational escape hatch back to
     # the pure greedy (--feature-gates LPGuide=false) like the reference's
@@ -93,6 +98,14 @@ class Options:
                        default=env.get("leader_elect", False))
         p.add_argument("--enable-profiling", action="store_true",
                        default=env.get("enable_profiling", False))
+        p.add_argument("--log-format", choices=("text", "json"),
+                       default=env.get("log_format", "text"),
+                       help="log line format; json emits structured lines "
+                            "with trace/span ids")
+        p.add_argument("--trace-slow-ms", type=float,
+                       default=env.get("trace_slow_ms", 0.0),
+                       help="WARN-log tracing spans slower than this "
+                            "many milliseconds (0 disables)")
         p.add_argument("--lp-refinery", action="store_true", default=False,
                        help="refine LP guides in a background worker so "
                             "ticks never block on column generation "
@@ -114,6 +127,8 @@ class Options:
             health_port=ns.health_port,
             leader_elect=ns.leader_elect,
             enable_profiling=ns.enable_profiling,
+            log_format=ns.log_format,
+            trace_slow_ms=ns.trace_slow_ms,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
@@ -138,6 +153,7 @@ class Options:
             "batch_max_duration": float,
             "metrics_port": int,
             "health_port": int,
+            "trace_slow_ms": float,
         }
         for f in fields(Options):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())

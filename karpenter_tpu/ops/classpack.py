@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..api.resources import ResourceList
+from ..utils import tracing
 from .ffd import NodeDecision, PackingResult
 from .tensorize import LaunchOption, Problem, pad_to
 
@@ -431,6 +432,7 @@ def solve_classpack_sweep(problem: Problem,
         cost[s:e] = out[:e - s, 0]
         n_new[s:e] = np.rint(out[:e - s, 1]).astype(np.int32)
         unsched[s:e] = np.rint(out[:e - s, 2]).astype(np.int32)
+    tracing.annotate(device_calls=calls, sweep_rows=B, sweep_chunk=chunk)
     return SweepResult(total_price=cost, new_nodes=n_new,
                        unschedulable=unsched, device_calls=calls)
 
@@ -459,7 +461,9 @@ def _device_podside(req_p: np.ndarray, cnt_p: np.ndarray,
                            digest_size=16).digest())
     hit = _PODSIDE_CACHE.get(key)
     if hit is not None:
+        tracing.annotate(podside_cache="hit")
         return hit
+    tracing.annotate(podside_cache="miss")
     val = (jnp.asarray(req_p), jnp.asarray(cnt_p), jnp.asarray(packed),
            jnp.asarray(cap_p))
     with _CACHE_LOCK:
@@ -508,7 +512,9 @@ def _device_catalog(alloc: np.ndarray, price: np.ndarray, rank: np.ndarray):
                digest_size=16).digest())
     hit = _CATALOG_CACHE.get(key)
     if hit is not None:
+        tracing.annotate(catalog_cache="hit")
         return hit
+    tracing.annotate(catalog_cache="miss")
     val = (jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank))
     with _CACHE_LOCK:
         while len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
@@ -606,6 +612,10 @@ def solve_classpack(problem: Problem,
     # slot count: never more nodes than pods; bucketed for compile reuse
     P = int(problem.class_counts.sum())
     K = max(min(max_nodes, pad_to(P + E, (256, 1024, 8192))), E + 1)
+    # pad buckets decide compile-cache reuse; device_calls counts the
+    # kernel dispatches this solve will issue (scan kernel = 1)
+    tracing.annotate(device_calls=1, pad_classes=Cpad, pad_options=Opad,
+                     slots=K)
 
     if E == 0:
         # the pure catalog side is reusable across solves — device-cached
@@ -655,102 +665,106 @@ def solve_classpack(problem: Problem,
         return PackingResult(nodes=nodes, unschedulable=[None] * n_unsched,
                              existing_assignments={}, total_price=total)
 
-    Ppad = pad_to(P)
-    if E == 0:
-        out = class_pack_assign_kernel_fresh(*pod_args, d_alloc, d_price,
-                                             d_rank, K, Ppad)
-    else:
-        out = class_pack_assign_kernel(*pod_args, d_alloc, d_price, d_rank,
-                                       *init_args(), K, Ppad)
-    assignment, slot_option, n_unsched = jax.device_get(out)
-    assignment = np.asarray(assignment, dtype=np.int32)[:P]
+    # kernel dispatch + the blocking device->host transfer
+    with tracing.span("solve.kernel"):
+        Ppad = pad_to(P)
+        if E == 0:
+            out = class_pack_assign_kernel_fresh(*pod_args, d_alloc, d_price,
+                                                 d_rank, K, Ppad)
+        else:
+            out = class_pack_assign_kernel(*pod_args, d_alloc, d_price, d_rank,
+                                           *init_args(), K, Ppad)
+        assignment, slot_option, n_unsched = jax.device_get(out)
+    # everything below is host-side decode: rows -> NodeDecisions
+    with tracing.span("solve.decode"):
+        assignment = np.asarray(assignment, dtype=np.int32)[:P]
 
-    # rows follow the sorted-class order, members consumed in sequence —
-    # the same walk the takes-based decode did, now fully vectorized
-    members_arr = problem.members_arrays()
-    pod_idx = (np.concatenate([members_arr[ci] for ci in order]) if C else
-               np.zeros(0, np.int64))
-    class_of_row = np.repeat(np.asarray(order, np.int64),
-                             problem.class_counts[order]) if C else \
-        np.zeros(0, np.int64)
+        # rows follow the sorted-class order, members consumed in sequence —
+        # the same walk the takes-based decode did, now fully vectorized
+        members_arr = problem.members_arrays()
+        pod_idx = (np.concatenate([members_arr[ci] for ci in order]) if C else
+                   np.zeros(0, np.int64))
+        class_of_row = np.repeat(np.asarray(order, np.int64),
+                                 problem.class_counts[order]) if C else \
+            np.zeros(0, np.int64)
 
-    sched = assignment >= 0
-    unschedulable = pod_idx[~sched].tolist()
-    ex = sched & (assignment < E)
-    existing_assignments = dict(zip(pod_idx[ex].tolist(),
-                                    assignment[ex].tolist()))
-    new_rows = np.nonzero(sched & (assignment >= E))[0]
-    new_rows = new_rows[np.argsort(assignment[new_rows], kind="stable")]
-    ks = assignment[new_rows]
-    # node boundaries by vectorized edge-detect: rows are slot-sorted, so
-    # each node is one contiguous run (np.split's per-group array machinery
-    # costs ~15ms at 5k nodes; slicing one pre-built list costs ~nothing)
-    starts = np.nonzero(np.diff(ks, prepend=np.int32(-1)))[0]
-    ends = np.append(starts[1:], len(ks))
-    node_slots = ks[starts] if len(starts) else np.zeros(0, np.int32)
+        sched = assignment >= 0
+        unschedulable = pod_idx[~sched].tolist()
+        ex = sched & (assignment < E)
+        existing_assignments = dict(zip(pod_idx[ex].tolist(),
+                                        assignment[ex].tolist()))
+        new_rows = np.nonzero(sched & (assignment >= E))[0]
+        new_rows = new_rows[np.argsort(assignment[new_rows], kind="stable")]
+        ks = assignment[new_rows]
+        # node boundaries by vectorized edge-detect: rows are slot-sorted, so
+        # each node is one contiguous run (np.split's per-group array machinery
+        # costs ~15ms at 5k nodes; slicing one pre-built list costs ~nothing)
+        starts = np.nonzero(np.diff(ks, prepend=np.int32(-1)))[0]
+        ends = np.append(starts[1:], len(ks))
+        node_slots = ks[starts] if len(starts) else np.zeros(0, np.int32)
 
-    # per-node resource usage, reconstructed host-side (the kernel no longer
-    # ships its K×R slot_used — one gather + reduceat replaces a 200KB+
-    # tunnel transfer); values are exact: same integer sums the kernel's
-    # alloc-minus-free bookkeeping produces
-    if len(starts):
-        row_reqs = problem.class_requests[class_of_row[new_rows]]
-        node_used = np.add.reduceat(row_reqs, starts, axis=0).astype(np.int64)
-    else:
-        node_used = np.zeros((0, problem.class_requests.shape[1]), np.int64)
+        # per-node resource usage, reconstructed host-side (the kernel no longer
+        # ships its K×R slot_used — one gather + reduceat replaces a 200KB+
+        # tunnel transfer); values are exact: same integer sums the kernel's
+        # alloc-minus-free bookkeeping produces
+        if len(starts):
+            row_reqs = problem.class_requests[class_of_row[new_rows]]
+            node_used = np.add.reduceat(row_reqs, starts, axis=0).astype(np.int64)
+        else:
+            node_used = np.zeros((0, problem.class_requests.shape[1]), np.int64)
 
-    # one global unique over (slot, class) pairs replaces a per-node
-    # np.unique; searchsorted then yields every node's class-set span
-    Cn = problem.num_classes
-    upq = np.unique(ks.astype(np.int64) * (Cn + 1) + class_of_row[new_rows]) \
-        if len(ks) else np.zeros(0, np.int64)
-    uslot, ucls = upq // (Cn + 1), upq % (Cn + 1)
-    cls_starts = np.searchsorted(uslot, node_slots, side="left")
-    cls_ends = np.searchsorted(uslot, node_slots, side="right")
+        # one global unique over (slot, class) pairs replaces a per-node
+        # np.unique; searchsorted then yields every node's class-set span
+        Cn = problem.num_classes
+        upq = np.unique(ks.astype(np.int64) * (Cn + 1) + class_of_row[new_rows]) \
+            if len(ks) else np.zeros(0, np.int64)
+        uslot, ucls = upq // (Cn + 1), upq % (Cn + 1)
+        cls_starts = np.searchsorted(uslot, node_slots, side="left")
+        cls_ends = np.searchsorted(uslot, node_slots, side="right")
 
-    # hot loop below runs once per node (~5-6k at 50k pods): stage every
-    # array it touches as plain Python lists — list indexing/slicing is an
-    # order of magnitude cheaper than per-element numpy scalar access
-    pod_sorted = pod_idx[new_rows].tolist()
-    node_oi = slot_option[node_slots].astype(np.int64)
-    # fleet cost: only pod-hosting slots launch.  Demand-driven opens
-    # always host ≥1 pod so this matches the old every-open-slot sum; the
-    # difference is guided solves, whose pre-opened-but-unfilled slots
-    # must not be bought.
-    launch_mask = (node_oi >= 0) & (node_oi < O)
-    total = float(problem.option_price[node_oi[launch_mask]].sum())
-    oi_l = node_oi.tolist()
-    starts_l, ends_l = starts.tolist(), ends.tolist()
-    options_l = problem.options
+        # hot loop below runs once per node (~5-6k at 50k pods): stage every
+        # array it touches as plain Python lists — list indexing/slicing is an
+        # order of magnitude cheaper than per-element numpy scalar access
+        pod_sorted = pod_idx[new_rows].tolist()
+        node_oi = slot_option[node_slots].astype(np.int64)
+        # fleet cost: only pod-hosting slots launch.  Demand-driven opens
+        # always host ≥1 pod so this matches the old every-open-slot sum; the
+        # difference is guided solves, whose pre-opened-but-unfilled slots
+        # must not be bought.
+        launch_mask = (node_oi >= 0) & (node_oi < O)
+        total = float(problem.option_price[node_oi[launch_mask]].sum())
+        oi_l = node_oi.tolist()
+        starts_l, ends_l = starts.tolist(), ends.tolist()
+        options_l = problem.options
 
-    compat_bits = np.packbits(problem.class_compat, axis=1)
-    ucls_l = ucls.tolist()
-    cs_l, ce_l = cls_starts.tolist(), cls_ends.tolist()
-    N = len(oi_l)
-    jcb_list: List = [None] * N
-    for i in range(N):
-        if not (0 <= oi_l[i] < O):
-            continue
-        cls = ucls_l[cs_l[i]:ce_l[i]]
-        jcb_list[i] = (compat_bits[cls[0]] if len(cls) == 1 else
-                       np.bitwise_and.reduce(compat_bits[cls], axis=0))
-    resolved = resolve_alternatives(problem, oi_l, jcb_list, node_used,
-                                    max_alternatives)
+        compat_bits = np.packbits(problem.class_compat, axis=1)
+        ucls_l = ucls.tolist()
+        cs_l, ce_l = cls_starts.tolist(), cls_ends.tolist()
+        N = len(oi_l)
+        jcb_list: List = [None] * N
+        for i in range(N):
+            if not (0 <= oi_l[i] < O):
+                continue
+            cls = ucls_l[cs_l[i]:ce_l[i]]
+            jcb_list[i] = (compat_bits[cls[0]] if len(cls) == 1 else
+                           np.bitwise_and.reduce(compat_bits[cls], axis=0))
+        resolved = resolve_alternatives(problem, oi_l, jcb_list, node_used,
+                                        max_alternatives)
 
-    nodes = []
-    for i in range(N):
-        hit = resolved[i]
-        if hit is None:
-            continue
-        nodes.append(NodeDecision(
-            option=options_l[oi_l[i]],
-            pod_indices=pod_sorted[starts_l[i]:ends_l[i]],
-            used=hit[1],
-            alternatives=hit[0],
-        ))
-    return PackingResult(nodes=nodes, unschedulable=unschedulable,
-                         existing_assignments=existing_assignments,
-                         total_price=total)
+        nodes = []
+        for i in range(N):
+            hit = resolved[i]
+            if hit is None:
+                continue
+            nodes.append(NodeDecision(
+                option=options_l[oi_l[i]],
+                pod_indices=pod_sorted[starts_l[i]:ends_l[i]],
+                used=hit[1],
+                alternatives=hit[0],
+            ))
+        return PackingResult(nodes=nodes, unschedulable=unschedulable,
+                             existing_assignments=existing_assignments,
+                             total_price=total)
 
 
 def resolve_alternatives(problem: Problem, oi_l: Sequence[int],

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..api.resources import ResourceList
+from ..utils import tracing
 from .tensorize import LaunchOption, Problem, pad_to
 
 NO_ASSIGNMENT = -1
@@ -199,10 +200,12 @@ def solve_ffd(problem: Problem,
                 backend = "native"
     if backend == "native":
         from .. import native
+        tracing.annotate(backend="native", device_calls=0)
         return native.solve_ffd_native(
             problem, max_nodes=max_nodes, existing_alloc=existing_alloc,
             existing_used=existing_used, existing_compat=existing_compat,
             max_alternatives=max_alternatives)
+    tracing.annotate(backend="jax", device_calls=1)
     E = 0 if existing_alloc is None else len(existing_alloc)
     ec = None
     if E:

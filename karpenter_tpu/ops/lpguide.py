@@ -51,7 +51,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .tensorize import Problem
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 _BIG = np.int32(2**30)
 
@@ -453,13 +453,16 @@ def _refine_job(problem: Problem, caps: np.ndarray, max_nodes: int, key,
     in the content-keyed cache (upgrading the next tick), then price the
     greedy alternative so the refinery can raise the one-shot re-solve
     hint when the refined mix is a real saving."""
-    hit = _compute_mix(problem, caps, stale_key, shape_key, clock=clock)
+    with tracing.span("refinery.lp"):
+        hit = _compute_mix(problem, caps, stale_key, shape_key, clock=clock)
     if hit is None:
         return None
-    _cache_put(_MIX_CACHE, _MIX_CACHE_MAX, key, hit)
-    from .classpack import solve_classpack
-    greedy = solve_classpack(problem, max_nodes=max_nodes, decode=False,
-                             guide=None)
+    with tracing.span("refinery.price") as sp:
+        _cache_put(_MIX_CACHE, _MIX_CACHE_MAX, key, hit)
+        from .classpack import solve_classpack
+        greedy = solve_classpack(problem, max_nodes=max_nodes, decode=False,
+                                 guide=None)
+        sp.annotate(z_lp=hit[3], greedy_total=float(greedy.total_price))
     return {"z_lp": hit[3], "greedy_total": float(greedy.total_price)}
 
 
@@ -516,6 +519,7 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
                 refinery.clock))
             if hit is None:
                 metrics.lpguide_requests().inc({"path": "cold"})
+                tracing.annotate(guide_path="cold")
                 return None
             path = "stale"
         else:
@@ -525,6 +529,7 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
                 return None
             _cache_put(_MIX_CACHE, _MIX_CACHE_MAX, key, hit)
     metrics.lpguide_requests().inc({"path": path})
+    tracing.annotate(guide_path=path)
     x, n_g, group_of, z_lp, ok, rejected = hit
     if rejected:
         return None

@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 log = logging.getLogger("karpenter_tpu.refinery")
 
@@ -89,8 +89,11 @@ class GuideRefinery:
             if key in self._inflight:
                 return False
             self._inflight.add(key)
+        # capture the submitting tick's span so the daemon's refine span
+        # joins the provisioning trace it was spawned from
+        ctx = tracing.TRACER.capture()
         try:
-            self._q.put_nowait((key, job))
+            self._q.put_nowait((key, job, ctx))
         except queue.Full:
             with self._lock:
                 self._inflight.discard(key)
@@ -102,13 +105,18 @@ class GuideRefinery:
     def _work(self) -> None:
         while not self._stop.is_set():
             try:
-                key, job = self._q.get(timeout=0.2)
+                key, job, ctx = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
             t0 = time.perf_counter()
             res = None
             try:
-                res = job()
+                with tracing.TRACER.attach(ctx), \
+                        tracing.span("refinery.refine") as sp:
+                    res = job()
+                    if res:
+                        sp.annotate(z_lp=res.get("z_lp"),
+                                    greedy_total=res.get("greedy_total"))
             except Exception:
                 metrics.refinery_errors().inc({"reason": "exception"})
                 log.exception("refine job failed; tick stays on greedy")

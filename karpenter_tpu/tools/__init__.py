@@ -1,0 +1,1 @@
+"""Operational helper tools (`python -m karpenter_tpu.tools.trace_demo`)."""

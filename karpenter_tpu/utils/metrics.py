@@ -431,6 +431,35 @@ def disruption_arena_requests() -> Counter:
         labels=("outcome",))
 
 
+def trace_span_duration() -> Histogram:
+    """Duration of every completed tracing span (utils/tracing.py), labeled
+    by span name — the histogram the /debug/traces timeline feeds so
+    Grafana needs no new scrape target."""
+    return REGISTRY.histogram(
+        "karpenter_trace_span_duration_seconds",
+        "Duration of one completed tracing span.",
+        labels=("span",),
+        buckets=(0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5))
+
+
+def trace_slow_spans() -> Counter:
+    """Spans that crossed the --trace-slow-ms WARN threshold, by name."""
+    return REGISTRY.counter(
+        "karpenter_trace_slow_spans_total",
+        "Spans slower than the configured slow-span threshold.",
+        labels=("span",))
+
+
+def provenance_records() -> Counter:
+    """Unschedulable-pod provenance records written, by the first failing
+    constraint (instance-type / nodepool / zone / capacity-type /
+    requirement / resource / capacity / no-offerings)."""
+    return REGISTRY.counter(
+        "karpenter_provenance_records_total",
+        "Pod scheduling-provenance records, by first failing constraint.",
+        labels=("constraint",))
+
+
 def disruption_candidates_truncated() -> Counter:
     """Candidates dropped by the max_candidates discovery cap — nonzero
     means 'swept everything' is NOT true for this cluster (no-silent-caps)."""

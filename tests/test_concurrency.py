@@ -259,3 +259,49 @@ class TestGuidedMixCacheConcurrency:
 
         hammer(body, iters=8)
         assert len(lpguide._MIX_CACHE) <= lpguide._MIX_CACHE_MAX
+
+
+class TestTracerConcurrency:
+    def test_ring_bounded_and_stacks_isolated_under_hammer(self):
+        """16 threads each open nested spans concurrently: every thread
+        sees its OWN parent (stacks are thread-local), the completed-root
+        ring never exceeds its bound, and every exported trace is
+        internally consistent."""
+        from karpenter_tpu.utils.tracing import Tracer
+        tr = Tracer(max_traces=32)
+
+        def one(t, i):
+            with tr.span(f"root-{t}") as root:
+                with tr.span("child") as child:
+                    assert child.trace_id == root.trace_id
+                    assert child.parent_id == root.span_id
+                    with tr.span("grandchild") as gc:
+                        assert gc.parent_id == child.span_id
+            assert tr.current() is None     # this thread's stack drained
+
+        hammer(one)
+        out = tr.traces()
+        assert len(out) == 32               # bounded despite 800 roots
+        for t_ in out:
+            assert [c["name"] for c in t_["children"]] == ["child"]
+            child = t_["children"][0]
+            assert child["trace_id"] == t_["trace_id"]
+            assert [g["name"] for g in child["children"]] == ["grandchild"]
+
+    def test_cross_thread_attach_under_contention(self):
+        """Many threads attach to one shared parent simultaneously — the
+        late-children append under the tracer lock must not lose spans."""
+        from karpenter_tpu.utils.tracing import Tracer
+        tr = Tracer()
+        with tr.span("shared-root") as root:
+            parent = tr.capture()
+
+            def one(t, i):
+                with tr.attach(parent), tr.span(f"w{t}"):
+                    pass
+
+            hammer(one, iters=10)
+        trace = tr.traces()[0]
+        assert len(trace["children"]) == N_THREADS * 10
+        assert all(c["trace_id"] == trace["trace_id"]
+                   for c in trace["children"])

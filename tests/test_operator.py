@@ -49,6 +49,20 @@ class TestOptions:
         o2 = Options.from_args(["--cluster-name", "flag-wins"])
         assert o2.cluster_name == "flag-wins"
 
+    def test_log_format_and_trace_slow_flags(self, monkeypatch):
+        o = Options.from_args([])
+        assert o.log_format == "text" and o.trace_slow_ms == 0.0
+        o = Options.from_args(["--log-format", "json",
+                               "--trace-slow-ms", "12.5"])
+        assert o.log_format == "json" and o.trace_slow_ms == 12.5
+        monkeypatch.setenv("KARPENTER_TPU_LOG_FORMAT", "json")
+        monkeypatch.setenv("KARPENTER_TPU_TRACE_SLOW_MS", "3")
+        o2 = Options.from_args([])
+        assert o2.log_format == "json" and o2.trace_slow_ms == 3.0
+        # explicit flag still beats env
+        o3 = Options.from_args(["--log-format", "text"])
+        assert o3.log_format == "text"
+
     def test_merge_settings_flag_precedence(self):
         o = Options.from_args(["--cluster-name", "flag"])
         o.merge_settings({"cluster-name": "cm", "batch-idle-duration": "3",
@@ -692,3 +706,124 @@ def test_apply_legacy_machine_registers_nodeclaim():
         op.apply({"apiVersion": "karpenter.tpu/v1alpha5", "kind": "Machine",
                   "metadata": {"name": "bad"},
                   "spec": {"requirements": [{"operator": "In"}]}})
+
+
+class TestDebugEndpoints:
+    """/debug/traces, /debug/pods/<name>, /debug/pprof (ISSUE PR3): all
+    JSON, traces queryable with ?min_ms=, pprof gated on
+    --enable-profiling."""
+
+    def _operator(self, clock, **opts):
+        op = Operator(Options(batch_idle_duration=1.0, batch_max_duration=10.0,
+                              **opts),
+                      catalog=generate_catalog(10), clock=lambda: clock[0])
+        op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 100, {}),
+                            SubnetInfo("s-b", "zone-b", 100, {})]
+        op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+        op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+        op.params.parameters = {
+            "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+        return op
+
+    def test_debug_traces_endpoint(self):
+        from karpenter_tpu.utils import tracing
+        clock = [100.0]
+        op = self._operator(clock)
+        mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            tracing.TRACER.reset()
+            op.cluster.add_pods([pod() for _ in range(4)])
+            mgr.tick()                       # opens the batch window
+            clock[0] += 1.1                  # idle elapses
+            mgr.tick()
+            res = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces", timeout=5)
+            assert res.headers["Content-Type"].startswith("application/json")
+            body = json.loads(res.read())
+            names = [t["name"] for t in body["traces"]]
+            assert "provision" in names
+            prov = body["traces"][names.index("provision")]
+            assert any(c["name"] == "provision.round"
+                       for c in prov["children"])
+            # min_ms filters (everything is faster than 10 minutes)
+            filtered = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces?min_ms=600000",
+                timeout=5).read())
+            assert filtered["traces"] == []
+            # malformed min_ms -> 400 with a JSON error body
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/traces?min_ms=bogus",
+                    timeout=5)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "error" in json.loads(e.read())
+        finally:
+            mgr.stop()
+            tracing.TRACER.reset()
+
+    def test_debug_pods_provenance_endpoint(self):
+        from karpenter_tpu.api import labels as wk
+        clock = [100.0]
+        op = self._operator(clock)
+        mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            stuck = Pod(name="stuck-pod",
+                        requests=ResourceList({CPU: 500,
+                                               MEMORY: 512 * 2**20}),
+                        node_selector={wk.ZONE: "zone-nowhere"})
+            op.cluster.add_pods([stuck])
+            mgr.tick()                       # opens the batch window
+            clock[0] += 1.1                  # idle elapses
+            mgr.tick()
+            res = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/pods/stuck-pod", timeout=5)
+            assert res.headers["Content-Type"].startswith("application/json")
+            body = json.loads(res.read())
+            assert body["pod"] == "stuck-pod"
+            assert body["constraint"] == "zone"
+            assert body["dimension"] == wk.ZONE
+            # unknown pod -> 404 JSON
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/pods/nobody", timeout=5)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                assert "error" in json.loads(e.read())
+        finally:
+            mgr.stop()
+
+    def test_debug_pprof_gated_and_json(self):
+        clock = [100.0]
+        op = self._operator(clock)           # profiling off by default
+        mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/pprof", timeout=5)
+                assert False, "expected 403"
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+                assert "error" in json.loads(e.read())
+        finally:
+            mgr.stop()
+        op2 = self._operator(clock, enable_profiling=True)
+        mgr2 = ControllerManager(op2, build_controllers(op2),
+                                 clock=lambda: clock[0])
+        port2 = mgr2.serve_endpoints(metrics_port=0)
+        try:
+            res = urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/debug/pprof", timeout=5)
+            assert res.headers["Content-Type"].startswith("application/json")
+            body = json.loads(res.read())
+            assert body["threads"]
+            me = [t for t in body["threads"] if t["frames"]]
+            assert me and all("thread_id" in t for t in body["threads"])
+            assert "traces" in body
+        finally:
+            mgr2.stop()

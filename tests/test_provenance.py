@@ -1,0 +1,155 @@
+"""Scheduling decision provenance parity (ISSUE PR3 acceptance): every pod
+the provisioner leaves unschedulable gets a record naming the FIRST failing
+requirement/constraint — instance-type, zone, capacity-type, a user label
+key, a resource dimension, or plain capacity — mirrored as a Warning
+`FailedScheduling` event and queryable from the store behind
+`/debug/pods/<name>`."""
+
+import pytest
+
+from helpers import cpu_pod, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import provenance
+from karpenter_tpu.utils.events import Recorder
+from karpenter_tpu.utils.provenance import (ProvenanceRecord, ProvenanceStore,
+                                            explain_unschedulable)
+
+
+def provision(pods, catalog=None):
+    provider = CloudProvider(FakeCloud(), catalog or small_catalog())
+    cluster = Cluster()
+    cluster.add_pods(pods)
+    store, rec = ProvenanceStore(), Recorder(log=False)
+    prov = Provisioner(provider, cluster, [NodePool()],
+                       recorder=rec, provenance=store)
+    out = prov.provision()
+    return out, store, rec
+
+
+class TestFirstFailingRequirement:
+    def test_instance_type(self):
+        pod = cpu_pod(name="bad-type",
+                      node_selector={wk.INSTANCE_TYPE: "no-such-type"})
+        out, store, _ = provision([pod])
+        assert [p.name for p in out.unschedulable] == ["bad-type"]
+        rec = store.get("bad-type")
+        assert rec.constraint == provenance.INSTANCE_TYPE
+        assert rec.dimension == wk.INSTANCE_TYPE
+        assert "no-such-type" in rec.message
+
+    def test_zone(self):
+        pod = cpu_pod(name="bad-zone", node_selector={wk.ZONE: "zone-z"})
+        out, store, _ = provision([pod])
+        rec = store.get("bad-zone")
+        assert rec.constraint == provenance.ZONE
+        assert rec.dimension == wk.ZONE
+        # the offered zones make the message actionable
+        assert "zone-a" in rec.message
+
+    def test_capacity_type(self):
+        # small_catalog offers on-demand only
+        pod = cpu_pod(name="spotty",
+                      node_selector={wk.CAPACITY_TYPE: "spot"})
+        out, store, _ = provision([pod])
+        rec = store.get("spotty")
+        assert rec.constraint == provenance.CAPACITY_TYPE
+        assert rec.dimension == wk.CAPACITY_TYPE
+
+    def test_resource_dimension(self):
+        # 64 cpu exceeds the largest a.xlarge (16 cpu)
+        pod = cpu_pod(name="huge", cpu_m=64_000)
+        out, store, _ = provision([pod])
+        rec = store.get("huge")
+        assert rec.constraint == provenance.RESOURCE
+        assert rec.dimension == "cpu"
+        assert rec.detail["requested"] > rec.detail["max_allocatable"]
+
+    def test_first_failure_wins_over_later_ones(self):
+        # both the instance type AND the zone are unsatisfiable: the filter
+        # order (instance-type before zone) decides which one is blamed
+        pod = cpu_pod(name="both",
+                      node_selector={wk.INSTANCE_TYPE: "no-such-type",
+                                     wk.ZONE: "zone-z"})
+        out, store, _ = provision([pod])
+        assert store.get("both").constraint == provenance.INSTANCE_TYPE
+
+    def test_user_label_requirement(self):
+        pod = cpu_pod(name="team-pod", node_selector={"example.com/team": "ml"})
+        out, store, _ = provision([pod])
+        rec = store.get("team-pod")
+        assert rec.constraint == provenance.REQUIREMENT
+        assert rec.dimension == "example.com/team"
+
+
+class TestParityAndEvents:
+    def test_every_unschedulable_pod_has_a_record(self):
+        pods = ([cpu_pod(name=f"ok-{i}") for i in range(5)]
+                + [cpu_pod(name="big", cpu_m=40_000),
+                   cpu_pod(name="lost-zone", node_selector={wk.ZONE: "nope"})])
+        out, store, rec = provision(pods)
+        unsched = {p.name for p in out.unschedulable}
+        assert unsched == {"big", "lost-zone"}
+        for name in unsched:
+            r = store.get(name)
+            assert r is not None and r.constraint
+        # scheduled pods carry no stale record
+        for i in range(5):
+            assert store.get(f"ok-{i}") is None
+
+    def test_warning_events_published(self):
+        pod = cpu_pod(name="evt-pod", cpu_m=40_000)
+        _, _, rec = provision([pod])
+        evs = [e for e in rec.events("FailedScheduling")
+               if e.name == "evt-pod"]
+        assert len(evs) == 1
+        assert evs[0].type == "Warning"
+        assert evs[0].kind == "Pod"
+        assert "resource" in evs[0].message
+
+    def test_binding_clears_prior_record(self):
+        provider = CloudProvider(FakeCloud(), small_catalog())
+        cluster = Cluster()
+        store, rec = ProvenanceStore(), Recorder(log=False)
+        prov = Provisioner(provider, cluster, [NodePool()],
+                          recorder=rec, provenance=store)
+        pod = cpu_pod(name="flappy")
+        store.record(ProvenanceRecord(pod="flappy",
+                                      constraint=provenance.CAPACITY,
+                                      message="stale"))
+        cluster.add_pods([pod])
+        out = prov.provision()
+        assert not out.unschedulable
+        assert store.get("flappy") is None
+
+
+class TestStore:
+    def test_fifo_cap_and_latest_wins(self):
+        s = ProvenanceStore(max_records=3)
+        for i in range(5):
+            s.record(ProvenanceRecord(pod=f"p{i}", constraint="capacity"))
+        assert len(s) == 3
+        assert s.get("p0") is None and s.get("p4") is not None
+        # re-recording refreshes recency and replaces the record
+        s.record(ProvenanceRecord(pod="p2", constraint="zone"))
+        assert s.get("p2").constraint == "zone"
+        assert len(s) == 3
+
+    def test_to_dict_round_trip(self):
+        r = ProvenanceRecord(pod="p", constraint="resource", dimension="cpu",
+                             message="m", detail={"requested": 4.0})
+        d = r.to_dict()
+        assert d["pod"] == "p" and d["constraint"] == "resource"
+        assert d["dimension"] == "cpu" and d["detail"] == {"requested": 4.0}
+
+
+class TestExplainDirect:
+    def test_no_offerings(self):
+        from karpenter_tpu.ops.tensorize import tensorize
+        pod = cpu_pod(name="stranded")
+        prob = tensorize([pod], [], [NodePool()])
+        rec = explain_unschedulable(prob, 0)
+        assert rec.constraint == provenance.NO_OFFERINGS
