@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test benchmark bench-smoke bench-consolidation benchmark-interruption trace-demo deflake native clean help
+.PHONY: test scale-test benchmark bench-smoke bench-consolidation bench-sim benchmark-interruption trace-demo sim-demo deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -22,11 +22,17 @@ bench-smoke: ## Fast bench sanity pass: 1k-homogeneous config only
 bench-consolidation: ## Consolidation-replay configs only (sweep + sequential baseline, refinery quiesced)
 	python bench.py --consolidation
 
+bench-sim: ## 24h diurnal replay speedup (sim-diurnal-24h, one JSON line)
+	python bench.py --sim
+
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py
 
 trace-demo: ## Provision + consolidate in-memory, pretty-print /debug/traces (docs/tracing.md)
 	JAX_PLATFORMS=cpu python -m karpenter_tpu.tools.trace_demo
+
+sim-demo: ## Replay the 24h diurnal scenario on the virtual clock (docs/simulation.md)
+	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/diurnal.yaml --seed 0
 
 deflake: ## Run the suite 5x to shake out order/timing flakes (Makefile:106-109)
 	for i in 1 2 3 4 5; do $(PYTEST) tests/ -q -p no:randomly || exit 1; done

@@ -422,7 +422,7 @@ def _run_child(env, timeout=3000):
     the caller then falls back rather than crashing without a JSON line."""
     bench = os.path.abspath(__file__)
     args = [sys.executable, bench, "--run"]
-    for flag in ("--smoke", "--consolidation"):
+    for flag in ("--smoke", "--consolidation", "--sim"):
         if flag in sys.argv[1:]:
             args.append(flag)
     try:
@@ -456,12 +456,44 @@ def main():
     sys.exit(1 if rc is None else rc)
 
 
-def run_all(smoke=False, consolidation=False):
+def run_all(smoke=False, consolidation=False, sim=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
     fallback = os.environ.get("KARPENTER_TPU_BENCH_FALLBACK")
     rng = np.random.default_rng(42)
+
+    if sim:
+        # `make bench-sim`: replay the canned 24h diurnal scenario through
+        # the real controller stack on the virtual clock; the headline is
+        # virtual-time compression (acceptance floor: 1000x real time)
+        from karpenter_tpu.sim import SimHarness, load_scenario
+        scenario = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "scenarios", "diurnal.yaml")
+        run = SimHarness(load_scenario(scenario), seed=0).run()
+        rep = run.report
+        log(f"[sim-diurnal-24h] virtual={run.virtual_seconds:.0f}s "
+            f"wall={run.wall_seconds:.2f}s speedup={run.speedup:.0f}x "
+            f"events={run.events_delivered} "
+            f"bound={rep['workload']['pods_bound']}"
+            f"/{rep['workload']['pods_arrived']} "
+            f"cost={rep['cost']['dollar_hours']:.1f}$h "
+            f"tick_exceptions={rep['errors']['tick_exceptions']}")
+        print(json.dumps({
+            "metric": "sim-diurnal-24h virtual-time speedup",
+            "value": round(run.speedup, 1),
+            "unit": "x",
+            "vs_baseline": round(run.speedup / 1000.0, 3),
+            "platform": platform,
+            "fallback": fallback,
+            "sim_virtual_seconds": round(run.virtual_seconds, 1),
+            "sim_wall_seconds": round(run.wall_seconds, 2),
+            "sim_events_delivered": run.events_delivered,
+            "sim_pods_bound": rep["workload"]["pods_bound"],
+            "sim_slo_violations": rep["slo"]["violations"],
+            "sim_dollar_hours": rep["cost"]["dollar_hours"],
+        }), flush=True)
+        return
 
     if consolidation:
         # `make bench-consolidation`: only the consolidation-replay configs
@@ -535,6 +567,7 @@ def run_all(smoke=False, consolidation=False):
 if __name__ == "__main__":
     if "--run" in sys.argv[1:]:
         run_all(smoke="--smoke" in sys.argv[1:],
-                consolidation="--consolidation" in sys.argv[1:])
+                consolidation="--consolidation" in sys.argv[1:],
+                sim="--sim" in sys.argv[1:])
     else:
         main()

@@ -9,6 +9,7 @@ end-to-end slice and benchmarks run against (SURVEY.md §7.4)."""
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import threading
 import time
@@ -138,6 +139,14 @@ class FakeCloud:
         self.launch_templates: Dict[str, LaunchTemplateInfo] = {}
         # (instance_type, zone) → spot price history, newest wins
         self.spot_prices: Dict[Tuple[str, str], float] = {}
+        # clock-scheduled deliveries: (at, seq, action, instance_id) heap,
+        # drained by deliver_due() — the virtual-time interruption pipeline
+        # (warning at T-120, reclaim at T)
+        self._scheduled: List[Tuple[float, int, str, str]] = []
+        self._sched_seq = itertools.count(1)
+        # every API call fails with RequestLimitExceeded while
+        # clock() < throttle_until (API throttle burst injection)
+        self.throttle_until: float = 0.0
 
     # ---- test knobs ----
     def reset(self):
@@ -146,6 +155,8 @@ class FakeCloud:
             self.insufficient_capacity_pools.clear()
             self.next_error = None
             self.calls.clear()
+            self._scheduled.clear()
+            self.throttle_until = 0.0
 
     def _count(self, api: str):
         self.calls[api] = self.calls.get(api, 0) + 1
@@ -154,6 +165,8 @@ class FakeCloud:
         if self.next_error is not None:
             err, self.next_error = self.next_error, None
             raise err
+        if self.clock() < self.throttle_until:
+            raise CloudError("RequestLimitExceeded", "throttle window open")
 
     # ---- APIs ----
     def create_fleet(self, overrides: Sequence[FleetOverride], count: int = 1,
@@ -303,11 +316,20 @@ class FakeCloud:
             self.queue.send(make_event_body(kind, ids, state=state,
                                             ts=self.clock()))
 
-    def interrupt(self, iid: str) -> CloudInstance:
-        """Spot-interrupt an instance. With a queue attached this publishes
-        the 2-minute warning and leaves the capacity up for the controller
-        to drain; without one there is nobody to warn, so the capacity is
-        reclaimed immediately (pre-queue behavior)."""
+    def interrupt(self, iid: str, at: Optional[float] = None,
+                  warning_s: float = 120.0) -> CloudInstance:
+        """Spot-interrupt an instance.
+
+        With ``at`` given, the whole pipeline is clock-scheduled: the
+        2-minute warning publishes at ``at - warning_s`` (clamped to now)
+        and the capacity is pulled at ``at`` — both fire from
+        `deliver_due()` when the injected clock reaches them, so virtual
+        time drives delivery.  Without ``at`` and with a queue attached,
+        the warning publishes immediately and the reclaim deadline is
+        scheduled ``warning_s`` out (drained by `deliver_due()`; callers
+        that never drain keep the old warn-only behavior and may still
+        `reclaim()` manually).  Without a queue there is nobody to warn,
+        so the capacity is reclaimed immediately (pre-queue behavior)."""
         with self._lock:
             inst = self._instances.get(iid)
             if inst is None:
@@ -315,9 +337,61 @@ class FakeCloud:
             if self.queue is None:
                 inst.state = "terminated"
                 return inst
+            if at is not None:
+                now = self.clock()
+                heapq.heappush(self._scheduled,
+                               (max(now, at - warning_s),
+                                next(self._sched_seq), "warn", iid))
+                heapq.heappush(self._scheduled,
+                               (at, next(self._sched_seq), "reclaim", iid))
+                return inst
+            heapq.heappush(self._scheduled,
+                           (self.clock() + warning_s,
+                            next(self._sched_seq), "reclaim", iid))
         from .queue import SPOT_INTERRUPTION
         self._publish(SPOT_INTERRUPTION, [iid])
         return inst
+
+    def next_due(self) -> Optional[float]:
+        """Earliest clock-scheduled delivery, or None."""
+        with self._lock:
+            return self._scheduled[0][0] if self._scheduled else None
+
+    def deliver_due(self) -> List[Dict]:
+        """Fire every scheduled delivery whose time has come.
+
+        Returns one record per firing:  ``spot_warning`` publishes the
+        interruption warning for a still-running instance;
+        ``spot_reclaim`` pulls the capacity — ``honored=True`` means the
+        controllers drained the node before the deadline (the instance was
+        already gone), ``False`` means the reclaim had to kill it."""
+        fired: List[Dict] = []
+        publish: List[Tuple[str, str, str]] = []
+        with self._lock:
+            now = self.clock()
+            while self._scheduled and self._scheduled[0][0] <= now:
+                at, _, action, iid = heapq.heappop(self._scheduled)
+                inst = self._instances.get(iid)
+                running = inst is not None and inst.state == "running"
+                if action == "warn":
+                    if running:
+                        publish.append(("spot_interruption", iid, ""))
+                        fired.append({"at": at, "action": "spot_warning",
+                                      "instance": iid})
+                    continue
+                honored = not running
+                if running:
+                    inst.state = "terminated"
+                    publish.append(("state_change", iid, "terminated"))
+                fired.append({"at": at, "action": "spot_reclaim",
+                              "instance": iid, "honored": honored})
+        if publish:
+            from .queue import SPOT_INTERRUPTION, STATE_CHANGE
+            kinds = {"spot_interruption": SPOT_INTERRUPTION,
+                     "state_change": STATE_CHANGE}
+            for kind, iid, state in publish:
+                self._publish(kinds[kind], [iid], state=state)
+        return fired
 
     def reclaim(self, iid: str) -> None:
         """The interruption deadline passed: capacity is pulled and a

@@ -64,8 +64,13 @@ class Operator:
                  clock: Callable[[], float] = time.time):
         self.options = options or Options()
         self.clock = clock
-        self.queue = queue or (FakeQueue(clock=clock)
-                               if self.options.interruption_queue else None)
+        # identity check, not truthiness: FakeQueue defines __len__, so an
+        # empty injected queue is falsy and `queue or ...` would silently
+        # swap in a fresh one — splitting the publisher (cloud) from the
+        # consumer (interruption controller)
+        self.queue = queue if queue is not None else (
+            FakeQueue(clock=clock)
+            if self.options.interruption_queue else None)
         self.cloud = cloud or FakeCloud(clock=clock, queue=self.queue)
         self.raw_cloud = self.cloud
         self.batched_cloud = BatchedCloud(self.cloud)
@@ -324,7 +329,9 @@ def build_controllers(op: Operator) -> Dict[str, object]:
     refinery = None
     if op.options.gate("LPGuide") and op.options.gate("LPRefinery"):
         from ..ops.refinery import GuideRefinery
-        refinery = GuideRefinery(clock=op.clock)
+        # both clocks ride the operator's injected clock: staleness AND
+        # drain deadlines follow virtual time under the simulator
+        refinery = GuideRefinery(clock=op.clock, monotonic=op.clock)
     provisioner = Provisioner(
         op.cloud_provider, op.cluster, op.nodepools,
         lp_guide=op.options.gate("LPGuide"),

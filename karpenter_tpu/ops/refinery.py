@@ -42,19 +42,24 @@ log = logging.getLogger("karpenter_tpu.refinery")
 class GuideRefinery:
     """Bounded, deduplicating background refinement queue.
 
-    `clock` feeds the staleness window only (tests inject fake clocks);
-    refine-latency metrics always use perf_counter.  `start=False` leaves
-    the worker unstarted — jobs accumulate until `start()` — which tests
-    use to observe the cold/stale tick behavior deterministically.
+    `clock` feeds the staleness window; `monotonic` feeds the drain
+    deadline — both injectable (the virtual-clock simulator injects its
+    clock for each so the refinery participates fully in virtual time;
+    tests inject fake clocks).  Refine-latency metrics always use
+    perf_counter.  `start=False` leaves the worker unstarted — jobs
+    accumulate until `start()` — which tests use to observe the
+    cold/stale tick behavior deterministically.
     """
 
     def __init__(self, max_queue: int = 64, stale_ttl: float = 300.0,
                  upgrade_threshold: float = 0.03,
                  clock: Callable[[], float] = time.monotonic,
+                 monotonic: Callable[[], float] = time.monotonic,
                  start: bool = True):
         self.stale_ttl = stale_ttl
         self.upgrade_threshold = upgrade_threshold
         self.clock = clock
+        self.monotonic = monotonic
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._inflight: set = set()
         self._lock = threading.Lock()
@@ -150,9 +155,12 @@ class GuideRefinery:
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until every submitted job finished (tests/bench); True
-        if the queue drained within the timeout."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        if the queue drained within the timeout.  The deadline runs on the
+        injected `monotonic` clock so a virtual-time harness bounds the
+        wait in virtual seconds; the 5ms poll is a thread yield to the
+        worker, not a timing source."""
+        deadline = self.monotonic() + timeout
+        while self.monotonic() < deadline:
             if self.pending() == 0:
                 return True
             time.sleep(0.005)

@@ -1,0 +1,455 @@
+"""The discrete-event simulation harness.
+
+Wires the *real* controller stack — provisioning, disruption, interruption,
+lifecycle, termination, GC, pricing — plus the fake cloud substrate onto a
+shared `VirtualClock`, then replays a scenario's expanded event stream
+against it.  Nothing in the loop sleeps: the harness advances the clock
+straight to the next due moment (scenario event, scheduled cloud delivery,
+controller cadence, or batch-window close), so a 24-hour diurnal day costs
+seconds of wall time and two runs of the same (scenario, seed) produce
+byte-identical event logs and reports.
+
+Determinism notes (each bit matters):
+  * module-global name counters (`state.cluster._names`, `api.objects._ids`,
+    `cloud.queue._msg_ids`, `cloud.fake._fleet_ids`) are reset per run so
+    node/message ids restart from 1 regardless of what ran earlier in the
+    process;
+  * the three request batchers keep their *wall* clock (their flusher
+    threads would deadlock against a virtual clock nobody advances) but
+    have their windows zeroed, so every call flushes immediately and
+    batching adds no wall time and no ordering nondeterminism;
+  * the harness's own randomness (reclaim victim selection, price jitter)
+    comes from one `numpy` Generator keyed on the run seed, consumed in
+    delivery order;
+  * the report excludes every wall-clock-derived value — speedup goes to
+    stderr/metrics/bench only.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api.taints import Taint
+from ..catalog.generate import generate_catalog
+from ..cloud.fake import (FakeCloud, ImageInfo, SecurityGroupInfo,
+                          SubnetInfo)
+from ..cloud.queue import FakeQueue
+from ..cloud.services import FakeParameterStore
+from ..operator.manager import ControllerManager
+from ..operator.operator import Operator, build_controllers
+from ..operator.options import Options
+from ..utils import metrics
+from . import events as ev
+from .clock import EventHeap, VirtualClock
+from .scenario import Scenario, expand
+
+log = logging.getLogger("karpenter_tpu.sim")
+
+# startup taint carried by booting nodes while their ready latency runs;
+# node.kubernetes.io/ prefix so the lifecycle controller *waits* on it
+# (it never clears condition-taints it does not own) until the harness's
+# NodeReady event removes it
+BOOT_TAINT = "node.kubernetes.io/sim-booting"
+
+# bounded zero-advance: consecutive same-time passes allowed before the
+# harness forces a minimum step (defends against due-time computation bugs
+# ever turning into an infinite same-instant loop)
+_MAX_ZERO_ADVANCES = 16
+_FORCED_STEP_S = 0.5
+
+
+@dataclass
+class SimRun:
+    """Everything one simulation produced.  `report` and `log` are fully
+    deterministic; `wall_seconds`/`speedup` are measurements about the run
+    and deliberately live outside the report document."""
+    report: Dict
+    log: List[Dict]
+    virtual_seconds: float
+    wall_seconds: float
+    events_delivered: int
+
+    @property
+    def speedup(self) -> float:
+        return self.virtual_seconds / self.wall_seconds \
+            if self.wall_seconds > 0 else float("inf")
+
+
+def _reset_global_counters() -> None:
+    """Restart the module-global id/name counters so object names are a
+    function of the run, not of process history."""
+    from ..api import objects as api_objects
+    from ..cloud import fake as cloud_fake
+    from ..cloud import queue as cloud_queue
+    from ..state import cluster as state_cluster
+    api_objects._ids = itertools.count()
+    state_cluster._names = itertools.count(1)
+    cloud_queue._msg_ids = itertools.count(1)
+    cloud_fake._fleet_ids = itertools.count(1)
+
+
+class SimHarness:
+    """One scenario replay over the real controller stack."""
+
+    def __init__(self, scenario: Scenario, seed: int = 0,
+                 duration_s: Optional[float] = None):
+        if duration_s is not None:
+            scenario = replace(scenario, duration_s=float(duration_s))
+        scenario.validate()
+        self.scenario = scenario
+        self.seed = int(seed)
+        _reset_global_counters()
+
+        self.clock = VirtualClock(scenario.start_s)
+        self.heap = EventHeap()
+        for at, event in expand(scenario, self.seed):
+            self.heap.push(at, event)
+        self._total_events = len(self.heap)
+        # harness-owned randomness (victim picks, price jitter): one stream,
+        # consumed in delivery order — distinct from the expansion streams
+        self._rng = np.random.default_rng([self.seed, 999])
+
+        # -- substrate + operator over the virtual clock ------------------
+        opts = Options(interruption_queue="sim-interruptions",
+                       batch_idle_duration=scenario.batch_idle_s,
+                       batch_max_duration=scenario.batch_max_s)
+        queue = FakeQueue(clock=self.clock)
+        cloud = FakeCloud(clock=self.clock, queue=queue)
+        cloud.subnets = [SubnetInfo(f"s-{z}", z, 1_000_000, {})
+                         for z in scenario.zones]
+        cloud.security_groups = [SecurityGroupInfo("sg-sim", "nodes", {})]
+        cloud.images = [ImageInfo("img-sim-1", "std", "amd64", 1.0)]
+        params = FakeParameterStore()
+        params.parameters = {
+            "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-sim-1"}
+        catalog = generate_catalog(scenario.catalog_size,
+                                   zones=scenario.zones)
+        # seed the spot market so price-drift faults have a base to move
+        for it in catalog:
+            for o in it.offerings:
+                if o.capacity_type == "spot":
+                    cloud.spot_prices[(it.name, o.zone)] = o.price
+        self.op = Operator(opts, cloud=cloud, catalog=catalog,
+                           params=params, queue=queue, clock=self.clock)
+        self.cloud = cloud
+        self.cluster = self.op.cluster
+        # batchers stay on the wall clock (their flusher threads would wait
+        # forever on a clock only this thread advances) but with zero-width
+        # windows every add() flushes immediately — no wall time, no
+        # cross-call coalescing to perturb ordering
+        for b in (self.op.batched_cloud.fleet, self.op.batched_cloud.describe,
+                  self.op.batched_cloud.terminate):
+            b.batcher.options.idle_timeout = 0.0
+            b.batcher.options.max_timeout = 0.0
+
+        controllers = build_controllers(self.op)
+        self.mgr = ControllerManager(self.op, controllers, clock=self.clock)
+        for entry in self.mgr._entries:
+            entry.interval = scenario.intervals.get(entry.name,
+                                                    entry.interval)
+        self._terminator = controllers.get("termination")
+        self._lifecycle = controllers.get("lifecycle")
+        self._queue = queue
+
+        # -- node-ready latency: intercept the sync register path ---------
+        self._ready_latency = float(scenario.node_ready_latency_s)
+        # booting node → pod uids bound there before it turned ready; their
+        # time-to-bind clock stops at NodeReady, not at the API bind
+        self._booting: Dict[str, List[str]] = {}
+        self._wrap_register()
+        self._wrap_bind()
+
+        # -- run bookkeeping ----------------------------------------------
+        self.log_entries: List[Dict] = []
+        self._arrive_t: Dict[str, float] = {}      # pod uid → arrival time
+        self._bind_t: Dict[str, float] = {}        # pod uid → time-to-bind
+        self._departed_unbound = 0
+        self._cost_dollar_hours = 0.0
+        self._node_hours = 0.0
+        self._peak_nodes = 0
+        self._events_by_kind: Dict[str, int] = {}
+        self._disruptions: Dict[str, int] = {}     # "kind/reason" → count
+        self._interruption_recycled = 0
+        self._liveness_terminated = 0
+        self._warnings = 0
+        self._reclaims_honored = 0
+        self._reclaims_forced = 0
+        self._tick_exceptions = 0
+
+    # ------------------------------------------------------------------
+    def _wrap_register(self) -> None:
+        """Model node-ready latency without touching the provisioner: the
+        sync path registers the node uninitialized and booting (a
+        node.kubernetes.io/* taint the lifecycle controller waits on);
+        a scheduled NodeReady event lifts the taint and the real
+        LifecycleController performs initialization on its next pass."""
+        original = self.cluster.register_nodeclaim
+        harness = self
+
+        def register(claim, allocatable, capacity=None, initialized=True,
+                     rehydrate=False):
+            if rehydrate or harness._ready_latency <= 0:
+                return original(claim, allocatable, capacity,
+                                initialized=initialized, rehydrate=rehydrate)
+            node = original(claim, allocatable, capacity,
+                            initialized=False, rehydrate=rehydrate)
+            node.taints = list(node.taints) + [Taint(BOOT_TAINT)]
+            harness._booting[node.name] = []
+            harness.heap.push(harness.clock.now() + harness._ready_latency,
+                              ev.NodeReady(node=node.name))
+            return node
+
+        self.cluster.register_nodeclaim = register
+
+    def _wrap_bind(self) -> None:
+        """Record each pod's first bind so the report's time-to-bind
+        percentiles come straight from harness state."""
+        original = self.cluster.bind_pod
+        harness = self
+
+        def bind(pod, node_name):
+            if pod.uid not in harness._bind_t and \
+                    pod.uid in harness._arrive_t:
+                if node_name in harness._booting:
+                    # node is still booting: the pod is placed but cannot
+                    # run — its bind clock stops at the NodeReady event
+                    harness._booting[node_name].append(pod.uid)
+                else:
+                    harness._bind_t[pod.uid] = \
+                        harness.clock.now() - harness._arrive_t[pod.uid]
+            original(pod, node_name)
+
+        self.cluster.bind_pod = bind
+
+    # ------------------------------------------------------------------
+    # event delivery
+    # ------------------------------------------------------------------
+    def _log(self, at: float, payload: Dict) -> None:
+        self.log_entries.append({"t": round(at - self.scenario.start_s, 6),
+                                 **payload})
+
+    def _deliver(self, at: float, event) -> None:
+        self._events_by_kind[event.kind] = \
+            self._events_by_kind.get(event.kind, 0) + 1
+        metrics.sim_events_delivered().inc({"kind": event.kind})
+        self._log(at, event.to_log())
+        if isinstance(event, ev.PodArrival):
+            now = self.clock.now()
+            for p in event.pods:
+                self._arrive_t[p.uid] = now
+            self.cluster.add_pods(event.pods)
+        elif isinstance(event, ev.PodDeparture):
+            for uid in event.uids:
+                pod = self.cluster.pods.get(uid)
+                if pod is None:
+                    continue
+                if uid not in self._bind_t:
+                    self._departed_unbound += 1
+                self.cluster.delete_pod(pod)
+                self.op.provenance.clear(pod.name)
+        elif isinstance(event, ev.SpotReclaim):
+            self._start_reclaims(event)
+        elif isinstance(event, ev.IceOpen):
+            self.cloud.insufficient_capacity_pools |= \
+                self._resolve_pools(event.pools)
+        elif isinstance(event, ev.IceClose):
+            self.cloud.insufficient_capacity_pools -= \
+                self._resolve_pools(event.pools)
+        elif isinstance(event, ev.PriceDrift):
+            self._drift_prices(event)
+        elif isinstance(event, ev.ApiThrottle):
+            self.cloud.throttle_until = max(
+                self.cloud.throttle_until,
+                self.clock.now() + event.duration_s)
+        elif isinstance(event, ev.NodeReadyLatency):
+            self._ready_latency = float(event.latency_s)
+        elif isinstance(event, ev.NodeReady):
+            node = self.cluster.nodes.get(event.node)
+            if node is not None:
+                node.taints = [t for t in node.taints
+                               if t.key != BOOT_TAINT]
+            now = self.clock.now()
+            for uid in self._booting.pop(event.node, []):
+                if uid not in self._bind_t and uid in self._arrive_t:
+                    self._bind_t[uid] = now - self._arrive_t[uid]
+
+    def _start_reclaims(self, event: ev.SpotReclaim) -> None:
+        """Pick victims among running spot capacity and schedule the
+        warn-then-reclaim pipeline on the cloud."""
+        with self.cloud._lock:
+            candidates = sorted(
+                iid for iid, inst in self.cloud._instances.items()
+                if inst.state == "running" and inst.capacity_type == "spot")
+        n = min(event.count, len(candidates))
+        if n == 0:
+            return
+        picks = sorted(self._rng.choice(len(candidates), size=n,
+                                        replace=False).tolist())
+        now = self.clock.now()
+        for i in picks:
+            self.cloud.interrupt(candidates[i], at=now + event.warning_s,
+                                 warning_s=event.warning_s)
+
+    def _resolve_pools(self, pools) -> set:
+        """Expand "*" wildcards against the catalog/zones, deterministically
+        (sorted iteration)."""
+        cap_types = ("on-demand", "spot")
+        type_names = sorted(it.name for it in self.op.catalog)
+        zones = sorted(self.scenario.zones)
+        out = set()
+        for ct, itype, zone in pools:
+            for c in (cap_types if ct == "*" else (ct,)):
+                for t in (type_names if itype == "*" else (itype,)):
+                    for z in (zones if zone == "*" else (zone,)):
+                        out.add((c, t, z))
+        return out
+
+    def _drift_prices(self, event: ev.PriceDrift) -> None:
+        for key in sorted(self.cloud.spot_prices):
+            jitter = 1.0
+            if event.jitter > 0:
+                jitter = 1.0 + event.jitter * float(
+                    self._rng.uniform(-1.0, 1.0))
+            self.cloud.spot_prices[key] = round(
+                self.cloud.spot_prices[key] * event.factor * jitter, 6)
+
+    def _on_cloud_delivery(self, rec: Dict) -> None:
+        if rec["action"] == "spot_warning":
+            self._warnings += 1
+            metrics.sim_reclaim_warnings().inc()
+            self._log(rec["at"], {"kind": "spot_warning",
+                                  "instance": rec["instance"]})
+        else:
+            honored = bool(rec.get("honored"))
+            if honored:
+                self._reclaims_honored += 1
+            else:
+                self._reclaims_forced += 1
+            metrics.sim_reclaims().inc(
+                {"honored": "true" if honored else "false"})
+            self._log(rec["at"], {"kind": "spot_reclaim_fired",
+                                  "instance": rec["instance"],
+                                  "honored": honored})
+
+    # ------------------------------------------------------------------
+    # controller ticking + due-time computation
+    # ------------------------------------------------------------------
+    def _tick(self) -> None:
+        try:
+            results = self.mgr.tick()
+        except Exception as e:
+            # provisioning runs unguarded inside the manager; a solver or
+            # cloud fault (e.g. an injected throttle burst) must cost one
+            # tick, not the run — and not a traceback per retry
+            self._tick_exceptions += 1
+            log.warning("sim tick failed at t=%.1f: %s",
+                        self.clock.now(), e)
+            return
+        disruption = results.get("disruption")
+        if disruption is not None and disruption.action is not None:
+            name = disruption.action.name
+            self._disruptions[name] = self._disruptions.get(name, 0) + 1
+        interruption = results.get("interruption")
+        if interruption is not None:
+            self._interruption_recycled += len(interruption.recycled)
+        lifecycle = results.get("lifecycle")
+        if lifecycle is not None:
+            self._liveness_terminated += len(lifecycle.liveness_terminated)
+
+    def _controller_due(self, now: float) -> float:
+        """Earliest moment any controller has work: entry cadences (skipping
+        no-op-prone loops with provably nothing to do) plus the pod batch
+        window's close."""
+        due = float("inf")
+        queue_busy = len(self._queue) > 0 or bool(self._queue._inflight)
+        termination_busy = bool(self._terminator and
+                                self._terminator.pending)
+        lifecycle_busy = bool(
+            getattr(self._lifecycle, "_pending", None) or
+            any(not c.initialized
+                for c in self.cluster.nodeclaims.values()))
+        for entry in self.mgr._entries:
+            if entry.name == "interruption" and not queue_busy:
+                continue
+            if entry.name == "termination" and not termination_busy:
+                continue
+            if entry.name == "lifecycle" and not lifecycle_busy:
+                continue
+            due = min(due, entry.last_run + entry.interval)
+        window = self.mgr.batch_window
+        if self.cluster.pending_pods():
+            if window._opened is None:
+                wdue = now              # next tick opens the window
+            else:
+                wdue = min(window._last_add + window.idle,
+                           window._opened + window.max_timeout)
+            # while a throttle burst has the cloud refusing every call,
+            # re-solving just burns ticks — back the launch path off to
+            # the window's end like a live controller's retry would
+            due = min(due, max(wdue, self.cloud.throttle_until))
+        return due
+
+    # ------------------------------------------------------------------
+    def run(self) -> SimRun:
+        sc = self.scenario
+        t_end = sc.start_s + sc.duration_s + sc.settle_s
+        wall0 = time.perf_counter()
+        zero_advances = 0
+        while True:
+            now = self.clock.now()
+            for at, event in self.heap.pop_due(now):
+                self._deliver(at, event)
+            for rec in self.cloud.deliver_due():
+                self._on_cloud_delivery(rec)
+            self._tick()
+            self._peak_nodes = max(self._peak_nodes,
+                                   len(self.cluster.nodes))
+            if now >= t_end:
+                break
+            target = min(t_end, self._next_due(now))
+            if target <= now:
+                zero_advances += 1
+                if zero_advances < _MAX_ZERO_ADVANCES:
+                    continue
+                target = now + _FORCED_STEP_S   # progress guard
+            zero_advances = 0
+            self._accrue(now, target)
+            self.clock.advance_to(target)
+        wall = time.perf_counter() - wall0
+        virtual = self.clock.now() - sc.start_s
+        speedup = virtual / wall if wall > 0 else float("inf")
+        metrics.sim_virtual_time_speedup().set(speedup)
+        total_reclaims = self._reclaims_honored + self._reclaims_forced
+        if total_reclaims:
+            metrics.sim_reclaim_honor_rate().set(
+                self._reclaims_honored / total_reclaims)
+        from .report import build_report
+        return SimRun(report=build_report(self), log=self.log_entries,
+                      virtual_seconds=virtual, wall_seconds=wall,
+                      events_delivered=sum(self._events_by_kind.values()))
+
+    def _next_due(self, now: float) -> float:
+        due = self._controller_due(now)
+        head = self.heap.peek_time()
+        if head is not None:
+            due = min(due, head)
+        cloud_due = self.cloud.next_due()
+        if cloud_due is not None:
+            due = min(due, cloud_due)
+        return due
+
+    def _accrue(self, t0: float, t1: float) -> None:
+        dt_h = (t1 - t0) / 3600.0
+        with self.cloud._lock:
+            rate = sum(inst.price for inst in self.cloud._instances.values()
+                       if inst.state == "running")
+            n = sum(1 for inst in self.cloud._instances.values()
+                    if inst.state == "running")
+        self._cost_dollar_hours += rate * dt_h
+        self._node_hours += n * dt_h

@@ -818,3 +818,45 @@ def register_parity_families() -> None:
         ret = sig.return_annotation
         if ret in ("Counter", "Gauge", "Histogram", Counter, Gauge, Histogram):
             fn()
+
+
+# ---------------------------------------------------------------------------
+# Simulation families (karpenter_tpu/sim) — populated only by sim runs;
+# zero-sample on a live operator like any other pre-registered family.
+# ---------------------------------------------------------------------------
+
+def sim_events_delivered() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_sim_events_delivered_total",
+        "Scenario events delivered by the simulation harness, by kind.",
+        labels=("kind",))
+
+
+def sim_virtual_time_speedup() -> Gauge:
+    """Virtual seconds replayed per wall second in the most recent sim run
+    — wall-clock derived, so it feeds metrics/bench output and never the
+    deterministic report JSON."""
+    return REGISTRY.gauge(
+        "karpenter_sim_virtual_time_speedup",
+        "Virtual seconds per wall second for the last simulation run.")
+
+
+def sim_reclaim_warnings() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_sim_reclaim_warnings_total",
+        "Spot-interruption warnings delivered ahead of scheduled reclaims.")
+
+
+def sim_reclaims() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_sim_reclaims_total",
+        "Scheduled spot reclaims fired, by whether the warning was honored "
+        "(capacity already drained when the deadline hit).",
+        labels=("honored",))
+
+
+def sim_reclaim_honor_rate() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_sim_reclaim_warning_honor_rate",
+        "Fraction of scheduled reclaims drained before their deadline in "
+        "the last simulation run.")

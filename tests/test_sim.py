@@ -1,0 +1,367 @@
+"""Virtual-clock simulator: clock/heap primitives, scenario DSL, the
+clock-scheduled interruption pipeline, determinism (same seed ⇒
+byte-identical event log and report), golden-report regression for the
+canned scenarios, and a sim-vs-live parity smoke.
+
+The full-24h replay (speedup acceptance) is `slow`-marked; the tier-1 pass
+covers the same machinery on truncated horizons.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from karpenter_tpu.cloud.fake import CloudInstance, FakeCloud
+from karpenter_tpu.cloud.queue import FakeQueue
+from karpenter_tpu.sim import (EventHeap, Scenario, ScenarioError, SimHarness,
+                               VirtualClock, expand, load_scenario,
+                               report_to_json)
+from karpenter_tpu.sim import events as ev
+from karpenter_tpu.sim.scenario import Fault, Wave, scenario_from_dict
+
+pytestmark = pytest.mark.sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCENARIOS = os.path.join(REPO, "scenarios")
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+def small_scenario(**kw):
+    defaults = dict(
+        name="small", duration_s=1800.0, settle_s=300.0, catalog_size=10,
+        workload=[Wave(kind="step", name="svc", at_s=60.0, count=8,
+                       duration_s=0.0, cpu_m=(250, 1000),
+                       mem_mib=(256, 1024))])
+    defaults.update(kw)
+    return Scenario(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# clock + heap primitives
+# ---------------------------------------------------------------------------
+
+class TestVirtualClock:
+    def test_advances_and_reads(self):
+        c = VirtualClock(100.0)
+        assert c() == c.now() == 100.0
+        c.advance(5.0)
+        c.advance_to(110.0)
+        assert c.now() == 110.0
+
+    def test_rewind_rejected(self):
+        c = VirtualClock(50.0)
+        with pytest.raises(ValueError):
+            c.advance_to(49.0)
+
+
+class TestEventHeap:
+    def test_orders_by_time_then_insertion(self):
+        h = EventHeap()
+        h.push(5.0, "late")
+        h.push(1.0, "a")
+        h.push(1.0, "b")        # same instant: insertion order preserved
+        assert h.peek_time() == 1.0
+        assert [e for _, e in h.pop_due(1.0)] == ["a", "b"]
+        assert len(h) == 1 and bool(h)
+        assert h.pop_due(10.0) == [(5.0, "late")]
+        assert not h
+
+
+# ---------------------------------------------------------------------------
+# scenario DSL
+# ---------------------------------------------------------------------------
+
+class TestScenarioDSL:
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ScenarioError, match="unknown scenario field"):
+            scenario_from_dict({"name": "x", "durations": 1,
+                                "workload": [{"kind": "step", "name": "w"}]})
+
+    def test_unknown_wave_kind_rejected(self):
+        sc = small_scenario()
+        sc.workload[0].kind = "sawtooth"
+        with pytest.raises(ScenarioError, match="unknown kind"):
+            sc.validate()
+
+    def test_canned_scenarios_load_and_expand(self):
+        for fname in ("diurnal.yaml", "spot-reclaim-storm.yaml",
+                      "ice-starvation.yaml"):
+            sc = load_scenario(os.path.join(SCENARIOS, fname))
+            stream = expand(sc, seed=0)
+            assert stream, fname
+            assert all(stream[i][0] <= stream[i + 1][0]
+                       for i in range(len(stream) - 1)), fname
+
+    def test_expansion_deterministic_and_seed_sensitive(self):
+        sc = load_scenario(os.path.join(SCENARIOS, "diurnal.yaml"))
+
+        def fingerprint(seed):
+            out = []
+            for at, event in expand(sc, seed):
+                if isinstance(event, ev.PodArrival):
+                    out.append((round(at, 9), tuple(
+                        (p.name, p.requests.get("cpu", 0)) for p in event.pods)))
+            return out
+
+        assert fingerprint(0) == fingerprint(0)
+        assert fingerprint(0) != fingerprint(1)
+
+    def test_adding_a_wave_never_perturbs_siblings(self):
+        sc = small_scenario()
+        base = [(at, tuple(p.name for p in e.pods))
+                for at, e in expand(sc, 7) if isinstance(e, ev.PodArrival)
+                if e.wave == "svc"]
+        sc2 = small_scenario()
+        sc2.workload.append(Wave(kind="batch", name="extra", at_s=100.0,
+                                 count=3, cohorts=2, every_s=600.0,
+                                 runtime_s=300.0))
+        grown = [(at, tuple(p.name for p in e.pods))
+                 for at, e in expand(sc2, 7) if isinstance(e, ev.PodArrival)
+                 if e.wave == "svc"]
+        assert base == grown
+
+
+# ---------------------------------------------------------------------------
+# clock-scheduled interruption delivery (FakeCloud satellite)
+# ---------------------------------------------------------------------------
+
+def _cloud_with_instance(start=1000.0):
+    clock = VirtualClock(start)
+    cloud = FakeCloud(clock=clock, queue=FakeQueue(clock=clock))
+    with cloud._lock:
+        cloud._instances["i-1"] = CloudInstance(
+            id="i-1", instance_type="t.small", zone="z-a",
+            capacity_type="spot", price=0.1, launched_at=start)
+    return clock, cloud
+
+
+class TestScheduledInterruption:
+    def test_warning_then_reclaim_on_the_virtual_clock(self):
+        clock, cloud = _cloud_with_instance()
+        cloud.interrupt("i-1", at=clock.now() + 300.0, warning_s=120.0)
+        assert cloud.next_due() == pytest.approx(1180.0)   # T-120
+        assert cloud.deliver_due() == []                   # nothing due yet
+        assert len(cloud.queue) == 0
+
+        clock.advance_to(1180.0)
+        fired = cloud.deliver_due()
+        assert [f["action"] for f in fired] == ["spot_warning"]
+        assert len(cloud.queue) == 1                       # warning published
+        assert cloud._instances["i-1"].state == "running"  # not pulled yet
+
+        clock.advance_to(1300.0)
+        fired = cloud.deliver_due()
+        assert [f["action"] for f in fired] == ["spot_reclaim"]
+        assert fired[0]["honored"] is False                # nobody drained it
+        assert cloud._instances["i-1"].state == "terminated"
+
+    def test_reclaim_honored_when_drained_before_deadline(self):
+        clock, cloud = _cloud_with_instance()
+        cloud.interrupt("i-1", at=clock.now() + 300.0, warning_s=120.0)
+        clock.advance_to(1180.0)
+        cloud.deliver_due()
+        # the controllers got the node off the instance in time
+        cloud.terminate_instances(["i-1"])
+        clock.advance_to(1300.0)
+        fired = cloud.deliver_due()
+        assert [f["action"] for f in fired] == ["spot_reclaim"]
+        assert fired[0]["honored"] is True
+
+    def test_warning_clamped_to_now_for_short_notice(self):
+        clock, cloud = _cloud_with_instance()
+        cloud.interrupt("i-1", at=clock.now() + 30.0, warning_s=120.0)
+        fired = cloud.deliver_due()                        # warn due NOW
+        assert [f["action"] for f in fired] == ["spot_warning"]
+
+
+# ---------------------------------------------------------------------------
+# harness end-to-end: determinism, SLO bookkeeping, interruption honor
+# ---------------------------------------------------------------------------
+
+class TestHarness:
+    def test_same_seed_byte_identical_log_and_report(self):
+        runs = [SimHarness(small_scenario(), seed=3).run() for _ in range(2)]
+        logs = [json.dumps(r.log, sort_keys=True) for r in runs]
+        reports = [report_to_json(r.report) for r in runs]
+        assert logs[0] == logs[1]
+        assert reports[0] == reports[1]
+
+    def test_step_wave_binds_everything(self):
+        run = SimHarness(small_scenario(), seed=0).run()
+        w = run.report["workload"]
+        assert w["pods_arrived"] == 8
+        assert w["pods_bound"] == 8
+        assert w["pods_pending_at_end"] == 0
+        assert run.report["errors"]["tick_exceptions"] == 0
+        assert run.report["cost"]["dollar_hours"] > 0
+
+    def test_spot_reclaim_storm_flows_through_interruption_controller(self):
+        sc = small_scenario(
+            duration_s=3600.0,
+            faults=[Fault(kind="spot_reclaim_storm", at_s=1200.0, count=2,
+                          warning_s=120.0, repeat=1)])
+        run = SimHarness(sc, seed=0).run()
+        spot = run.report["spot"]
+        assert spot["warnings"] == 2
+        assert spot["reclaims"] == 2
+        # the 2-minute warning gives the real interruption controller time
+        # to cordon & drain, so the deadline finds the capacity already gone
+        assert spot["reclaims_honored"] == 2
+        assert run.report["churn"]["interruption_recycled"] == 2
+
+    def test_node_ready_latency_delays_binds(self):
+        fast = SimHarness(small_scenario(), seed=0).run()
+        slow_run = SimHarness(small_scenario(node_ready_latency_s=90.0),
+                              seed=0).run()
+        assert slow_run.report["time_to_bind_s"]["p50"] >= \
+            fast.report["time_to_bind_s"]["p50"] + 60.0
+
+    def test_no_wall_sleeps_in_the_sim_path(self):
+        import karpenter_tpu.sim as sim_pkg
+        root = os.path.dirname(sim_pkg.__file__)
+        for fname in sorted(os.listdir(root)):
+            if fname.endswith(".py"):
+                with open(os.path.join(root, fname)) as fh:
+                    assert "time.sleep" not in fh.read(), fname
+
+
+# ---------------------------------------------------------------------------
+# golden-report regression (truncated horizons of the canned scenarios)
+# ---------------------------------------------------------------------------
+
+GOLDEN_CASES = [
+    ("diurnal", "diurnal.yaml", 7200.0),
+    ("spot-reclaim-storm", "spot-reclaim-storm.yaml", 7200.0),
+    ("ice-starvation", "ice-starvation.yaml", 5400.0),
+]
+
+
+@pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
+                         ids=[c[0] for c in GOLDEN_CASES])
+def test_golden_report(name, fname, duration):
+    """Byte-for-byte report stability for each canned scenario at seed 0.
+
+    Regenerate after an intentional behavior change with the one-liner in
+    tests/golden/README.md.
+    """
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"report for {fname} (seed 0, {duration:.0f}s) drifted from "
+            f"{path}; if the change is intentional, regenerate the golden")
+
+
+# ---------------------------------------------------------------------------
+# sim-vs-live parity smoke
+# ---------------------------------------------------------------------------
+
+def test_sim_matches_live_operator_on_the_same_workload():
+    """The harness is the REAL stack on a virtual clock: the same expanded
+    pods pushed through a plain wall-clock Operator must bind identically
+    (same pod set, same fleet size)."""
+    import time as _time
+
+    from karpenter_tpu.cloud.fake import (ImageInfo, SecurityGroupInfo,
+                                          SubnetInfo)
+    from karpenter_tpu.cloud.services import FakeParameterStore
+    from karpenter_tpu.operator.manager import ControllerManager
+    from karpenter_tpu.operator.operator import Operator, build_controllers
+    from karpenter_tpu.operator.options import Options
+
+    sc = small_scenario()
+    sim_harness = SimHarness(sc, seed=5)
+    sim = sim_harness.run()
+    assert sim.report["workload"]["pods_bound"] == 8
+
+    pods = [p for _, e in expand(sc, seed=5)
+            if isinstance(e, ev.PodArrival) for p in e.pods]
+    cloud = FakeCloud(clock=_time.time)
+    cloud.subnets = [SubnetInfo(f"s-{z}", z, 1_000_000, {})
+                     for z in sc.zones]
+    cloud.security_groups = [SecurityGroupInfo("sg-live", "nodes", {})]
+    cloud.images = [ImageInfo("img-live-1", "std", "amd64", 1.0)]
+    params = FakeParameterStore()
+    params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-live-1"}
+    op = Operator(Options(batch_idle_duration=0.0, batch_max_duration=0.0),
+                  cloud=cloud, catalog=sim_harness.op.catalog, params=params,
+                  clock=_time.time)
+    mgr = ControllerManager(op, build_controllers(op), clock=_time.time)
+    op.cluster.add_pods(pods)
+    for _ in range(3):
+        mgr.tick()
+    live_bound = {p.uid for p in op.cluster.pods.values() if p.node_name}
+    assert live_bound == {p.uid for p in pods}
+    assert len(op.cloud.running()) == sim.report["cost"]["peak_nodes"]
+
+
+# ---------------------------------------------------------------------------
+# CLI + simcheck + refinery clock injection
+# ---------------------------------------------------------------------------
+
+def test_cli_writes_report_and_log(tmp_path):
+    from karpenter_tpu.sim.__main__ import main
+    spec = tmp_path / "tiny.yaml"
+    spec.write_text(
+        "name: tiny\nduration_s: 900\nsettle_s: 120\ncatalog_size: 8\n"
+        "workload:\n  - kind: step\n    name: w\n    at_s: 30\n"
+        "    count: 4\n    duration_s: 0\n")
+    out = tmp_path / "report.json"
+    logf = tmp_path / "events.jsonl"
+    rc = main([str(spec), "--seed", "1", "--out", str(out),
+               "--log", str(logf)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["scenario"] == "tiny" and report["seed"] == 1
+    lines = [json.loads(ln) for ln in logf.read_text().splitlines()]
+    assert any(entry["kind"] == "pod_arrival" for entry in lines)
+
+
+def test_cli_rejects_bad_scenario(tmp_path):
+    from karpenter_tpu.sim.__main__ import main
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("name: bad\nworkload: []\n")
+    assert main([str(bad)]) == 2
+
+
+def test_simcheck_validates_and_counts():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "simcheck.py"),
+         os.path.join(SCENARIOS, "diurnal.yaml")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "valid: yes" in proc.stdout
+    assert "events: " in proc.stdout
+
+
+def test_refinery_drain_deadline_runs_on_injected_monotonic():
+    from karpenter_tpu.ops.refinery import GuideRefinery
+    fake_now = [0.0]
+
+    def fake_monotonic():
+        fake_now[0] += 10.0      # every deadline check costs 10 fake seconds
+        return fake_now[0]
+
+    r = GuideRefinery(start=False, monotonic=fake_monotonic)
+    r._inflight.add("job")       # never completes: drain must give up via
+    assert r.drain(timeout=25.0) is False   # the injected clock, not wall
+    assert fake_now[0] <= 60.0   # a wall-clock deadline would spin ~forever
+
+
+# ---------------------------------------------------------------------------
+# full-horizon acceptance (excluded from tier-1 via -m 'not slow')
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_diurnal_24h_replay_speedup_and_determinism():
+    sc = load_scenario(os.path.join(SCENARIOS, "diurnal.yaml"))
+    runs = [SimHarness(sc, seed=0).run() for _ in range(2)]
+    assert runs[0].virtual_seconds >= 86_400.0
+    assert runs[0].speedup >= 1000.0
+    assert report_to_json(runs[0].report) == report_to_json(runs[1].report)
