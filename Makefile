@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test benchmark bench-smoke bench-consolidation bench-sim benchmark-interruption trace-demo sim-demo deflake native clean help
+.PHONY: test scale-test benchmark bench-smoke bench-consolidation bench-sim bench-forecast benchmark-interruption trace-demo sim-demo deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -24,6 +24,9 @@ bench-consolidation: ## Consolidation-replay configs only (sweep + sequential ba
 
 bench-sim: ## 24h diurnal replay speedup (sim-diurnal-24h, one JSON line)
 	python bench.py --sim
+
+bench-forecast: ## Predictive-headroom A/B: diurnal-forecast on vs off (one JSON line)
+	python bench.py --forecast
 
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py

@@ -388,13 +388,24 @@ def run_interruption_benchmark(sizes=(100, 1000, 5000, 15000)):
 _PROBE_CACHE: dict = {}
 
 
-def _probe_backend(timeout=45.0):
+def _probe_backend(timeout=None):
     """Report the JAX platform visible to a throwaway bounded subprocess,
     or None if init fails/hangs.  Probes exactly once per process and
-    caches the answer — a hung TPU tunnel costs ONE bounded timeout, not
-    one per call site or retry (the r5 bench burned 2x120s here)."""
+    caches the answer (negative included) — a hung TPU tunnel costs ONE
+    bounded timeout for the whole run, not one per call site or retry
+    (the r5 bench burned 2x120s here).  The timeout is env-overridable
+    (KARPENTER_TPU_BENCH_PROBE_TIMEOUT), and an explicit JAX_PLATFORMS
+    pin skips the subprocess entirely — nothing to discover."""
     if "plat" in _PROBE_CACHE:
         return _PROBE_CACHE["plat"]
+    if timeout is None:
+        timeout = float(os.environ.get(
+            "KARPENTER_TPU_BENCH_PROBE_TIMEOUT", "45"))
+    pinned = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if pinned:
+        log(f"backend probe: skipped (JAX_PLATFORMS={pinned} pinned)")
+        _PROBE_CACHE["plat"] = pinned
+        return pinned
     code = "import jax; print('PLAT=%s' % jax.devices()[0].platform)"
     plat = None
     try:
@@ -422,7 +433,7 @@ def _run_child(env, timeout=3000):
     the caller then falls back rather than crashing without a JSON line."""
     bench = os.path.abspath(__file__)
     args = [sys.executable, bench, "--run"]
-    for flag in ("--smoke", "--consolidation", "--sim"):
+    for flag in ("--smoke", "--consolidation", "--sim", "--forecast"):
         if flag in sys.argv[1:]:
             args.append(flag)
     try:
@@ -448,7 +459,7 @@ def main():
         reason = f"run on probed platform {plat} failed rc={rc}"
         log(f"bench {reason}; retrying on cpu")
     else:
-        reason = "backend probe failed (45s timeout)"
+        reason = "backend probe failed (bounded timeout)"
         log(f"{reason} — falling back to cpu platform")
     env = _virtual_cpu_env(n_devices=1)
     env["KARPENTER_TPU_BENCH_FALLBACK"] = reason
@@ -456,12 +467,53 @@ def main():
     sys.exit(1 if rc is None else rc)
 
 
-def run_all(smoke=False, consolidation=False, sim=False):
+def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
     fallback = os.environ.get("KARPENTER_TPU_BENCH_FALLBACK")
     rng = np.random.default_rng(42)
+
+    if forecast:
+        # `make bench-forecast`: the predictive-headroom value proof — the
+        # 24h diurnal+batch scenario replayed with forecasting on vs off
+        # (same seed, same event stream), headline = ttb p95 improvement
+        # at the report's $.h cost delta (acceptance: >=30% at <=10%)
+        from karpenter_tpu.sim import SimHarness, load_scenario
+        scenario = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "scenarios", "diurnal-forecast.yaml")
+        reports = {}
+        for on in (False, True):
+            run = SimHarness(load_scenario(scenario), seed=0,
+                             forecast=on).run()
+            reports[on] = run.report
+            tag = "on" if on else "off"
+            log(f"[forecast-ab-{tag}] "
+                f"p95={run.report['time_to_bind_s']['p95']}s "
+                f"cost={run.report['cost']['dollar_hours']}$h "
+                f"wall={run.wall_seconds:.1f}s")
+        p_off = reports[False]["time_to_bind_s"]["p95"]
+        p_on = reports[True]["time_to_bind_s"]["p95"]
+        c_off = reports[False]["cost"]["dollar_hours"]
+        c_on = reports[True]["cost"]["dollar_hours"]
+        improvement = (p_off - p_on) / p_off if p_off else 0.0
+        cost_delta = (c_on - c_off) / c_off if c_off else 0.0
+        print(json.dumps({
+            "metric": "diurnal-forecast A/B time-to-bind p95 improvement",
+            "value": round(100.0 * improvement, 1),
+            "unit": "%",
+            "vs_baseline": round(improvement / 0.30, 3),
+            "platform": platform,
+            "fallback": fallback,
+            "forecast_ttb_p95_improvement": round(improvement, 4),
+            "forecast_cost_delta_pct": round(100.0 * cost_delta, 2),
+            "forecast_ttb_p95_off_s": p_off,
+            "forecast_ttb_p95_on_s": p_on,
+            "forecast_dollar_hours_off": c_off,
+            "forecast_dollar_hours_on": c_on,
+            "forecast_stats": reports[True].get("forecast"),
+        }), flush=True)
+        return
 
     if sim:
         # `make bench-sim`: replay the canned 24h diurnal scenario through
@@ -568,6 +620,7 @@ if __name__ == "__main__":
     if "--run" in sys.argv[1:]:
         run_all(smoke="--smoke" in sys.argv[1:],
                 consolidation="--consolidation" in sys.argv[1:],
-                sim="--sim" in sys.argv[1:])
+                sim="--sim" in sys.argv[1:],
+                forecast="--forecast" in sys.argv[1:])
     else:
         main()

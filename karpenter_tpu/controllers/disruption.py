@@ -40,6 +40,7 @@ from ..api.taints import NO_SCHEDULE, Taint
 from ..catalog.instancetype import InstanceType, effective_instance_type
 from ..cloud.fake import CloudError
 from ..cloud.provider import CloudProvider, InsufficientCapacityError
+from ..forecast.headroom import headroom_expiry, is_headroom
 from ..ops.classpack import solve_classpack
 from ..ops.constraints import (LEVEL_REQUIRED_ONLY,
                                find_batch_topology_violations, lower_pods,
@@ -198,7 +199,22 @@ class DisruptionController:
             if node.nominated_until > now:
                 continue  # in-flight pod nomination
             blocked = ""
-            for p in node.pods:
+            # live headroom is protected by TTL: consolidating a node that
+            # carries an unexpired placeholder would strand capacity the
+            # forecaster just bought (placeholders are ownerless — they die
+            # with the node — so the controller would re-buy, boot a fresh
+            # node, and the sweep would eat it again: a launch-churn loop).
+            # The freeze is bounded by the TTL; once demand is gone the
+            # forecaster stops renewing and the node drains normally.
+            # Expired headroom neither blocks nor reschedules.
+            real = [p for p in node.pods
+                    if not p.is_daemon and not is_headroom(p)]
+            ttl_max = max((headroom_expiry(p) or 0.0
+                           for p in node.pods if is_headroom(p)),
+                          default=0.0)
+            if ttl_max > now:
+                blocked = "live headroom (protected by ttl)"
+            for p in real:
                 if p.do_not_disrupt:
                     blocked = f"pod {p.name} has do-not-disrupt"
                     break
@@ -212,7 +228,7 @@ class DisruptionController:
                 self.recorder.publish(Event(
                     "Node", node.name, "Unconsolidatable", blocked))
                 continue
-            resched = [p for p in node.pods if not p.is_daemon]
+            resched = real
             if not self.cluster.evictable(resched, budgets):
                 self.recorder.publish(Event(
                     "Node", node.name, "Unconsolidatable",

@@ -61,6 +61,9 @@ class InterruptionController:
         self.cluster = cluster
         self.terminator = terminator
         self.clock = clock
+        # optional hook(node_or_claim) fired on each observed spot reclaim
+        # — the forecast spot-risk prior subscribes here (operator wiring)
+        self.on_spot_reclaim: Optional[Callable] = None
 
     # ------------------------------------------------------------------
     def reconcile(self, max_batches: int = 1) -> InterruptionResult:
@@ -114,6 +117,9 @@ class InterruptionController:
                 continue  # not ours / already gone
             if event.kind == SPOT_INTERRUPTION:
                 self._mark_spot_unavailable(node, claim)
+                src = node or claim
+                if src is not None and self.on_spot_reclaim is not None:
+                    self.on_spot_reclaim(src)
             if event.kind == REBALANCE_RECOMMENDATION:
                 continue  # observability only, no action (reference default)
             if event.kind == STATE_CHANGE and \

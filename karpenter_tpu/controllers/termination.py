@@ -153,7 +153,9 @@ class TerminationController:
         ownerless pods are gone for good."""
         self.cluster.unbind_pod(pod)
         if not pod.owner_kind:
-            self.cluster.pods.pop(pod.uid, None)
+            if self.cluster.pods.pop(pod.uid, None) is not None and \
+                    self.cluster.observer is not None:
+                self.cluster.observer.pod_removed(pod)
         else:
             # the replacement pod is a fresh arrival — without this, its
             # re-bind would record the pod's whole lifetime as bind latency

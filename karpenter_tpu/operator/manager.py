@@ -154,6 +154,7 @@ class ControllerManager:
         "nodeclass": 300.0,
         "interruption": 0.5,
         "pricing": 60.0,
+        "forecast": 30.0,
     }
 
     def __init__(self, operator, controllers: Dict[str, object],
@@ -175,8 +176,10 @@ class ControllerManager:
                 reconcile = self._nodeclass_tick(ctrl)
             else:
                 reconcile = ctrl.reconcile
-            self._entries.append(_Entry(
-                name, reconcile, self.DEFAULT_INTERVALS.get(name, 10.0)))
+            interval = self.DEFAULT_INTERVALS.get(name, 10.0)
+            if name == "forecast":
+                interval = operator.options.forecast_cadence_s
+            self._entries.append(_Entry(name, reconcile, interval))
             # static controller-runtime gauges, set ONCE: singleton loops
             # have concurrency 1, and active_workers reads 0 from any
             # scrape because reconciles run under the same state lock the
@@ -227,6 +230,12 @@ class ControllerManager:
                     and refinery.take_upgrade():
                 ripe = True
             if ripe:
+                # real pending pods evict headroom placeholders BEFORE the
+                # solve so the freed warm capacity is schedulable this tick
+                # — that immediacy is the whole point of headroom
+                forecast = self.controllers.get("forecast")
+                if forecast is not None:
+                    forecast.preempt_for_pending()
                 results["provisioning"] = prov.provision()
                 self.batch_window.reset()
         for e in self._entries:

@@ -366,4 +366,35 @@ def build_controllers(op: Operator) -> Dict[str, object]:
             clock=op.clock)
     if not op.options.isolated_network:
         out["pricing"] = PricingController(op.pricing, clock=op.clock)
+    if op.options.gate("Forecast"):
+        from ..forecast import (DemandSeries, HeadroomConfig,
+                                HeadroomController, make_forecaster)
+        opts = op.options
+        series = DemandSeries(bucket_s=opts.forecast_bucket_s, clock=op.clock)
+        # the series observes every pod mutation through the cluster hook;
+        # headroom placeholders are filtered inside the series so the
+        # forecaster never learns from its own output
+        op.cluster.observer = series
+        season_steps = max(2, int(opts.forecast_season_s /
+                                  max(opts.forecast_bucket_s, 1.0)))
+        forecaster = make_forecaster(opts.forecast_model,
+                                     season_length=season_steps)
+        cfg = HeadroomConfig(
+            horizon_s=opts.forecast_horizon_s,
+            lead_s=opts.forecast_lead_s,
+            ttl_s=opts.forecast_ttl_s,
+            bucket_s=opts.forecast_bucket_s,
+            confidence=opts.forecast_confidence,
+            max_cost_frac=opts.forecast_max_cost_frac,
+            model=opts.forecast_model,
+            season_s=opts.forecast_season_s)
+        forecast = HeadroomController(
+            provisioner, op.cluster, op.nodepools, series, forecaster,
+            clock=op.clock, config=cfg, recorder=op.recorder)
+        out["forecast"] = forecast
+        # spot reclaims observed by the interruption controller feed the
+        # per-pool risk prior that steers risky headroom onto on-demand
+        if "interruption" in out:
+            out["interruption"].on_spot_reclaim = \
+                forecast.spot_prior.observe_reclaim
     return out

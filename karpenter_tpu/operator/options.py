@@ -61,9 +61,22 @@ class Options:
     # refined mix upgrades the next tick.  Off by default while it
     # graduates; enable with --lp-refinery or --feature-gates
     # LPRefinery=true (requires LPGuide).
+    # Forecast: demand forecasting + proactive headroom provisioning
+    # (karpenter_tpu/forecast/) — off by default; enable with --forecast
+    # or --feature-gates Forecast=true.  Knobs below (docs/forecast.md).
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
-                                 "LPRefinery": False})
+                                 "LPRefinery": False, "Forecast": False})
+    # forecast/headroom knobs (used only with the Forecast gate on)
+    forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
+    forecast_horizon_s: float = 900.0      # forecast window length
+    forecast_lead_s: float = 180.0         # window starts this far ahead
+    forecast_ttl_s: float = 600.0          # placeholder lifetime
+    forecast_bucket_s: float = 60.0        # demand-series bucket width
+    forecast_confidence: float = 1.64      # z for the upper band (~p95)
+    forecast_max_cost_frac: float = 0.10   # headroom $/h cap vs cluster rate
+    forecast_model: str = "holtwinters"    # "ewma" | "holtwinters"
+    forecast_season_s: float = 86_400.0    # Holt-Winters season (diurnal)
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
@@ -110,6 +123,27 @@ class Options:
                        help="refine LP guides in a background worker so "
                             "ticks never block on column generation "
                             "(shorthand for --feature-gates LPRefinery=true)")
+        p.add_argument("--forecast", action="store_true", default=False,
+                       help="enable demand forecasting + proactive headroom "
+                            "provisioning (shorthand for --feature-gates "
+                            "Forecast=true)")
+        p.add_argument("--forecast-cadence", type=float, dest="forecast_cadence_s",
+                       default=env.get("forecast_cadence_s", 30.0),
+                       help="seconds between headroom reconciles")
+        p.add_argument("--forecast-horizon", type=float,
+                       dest="forecast_horizon_s",
+                       default=env.get("forecast_horizon_s", 900.0),
+                       help="forecast window length in seconds")
+        p.add_argument("--forecast-lead", type=float, dest="forecast_lead_s",
+                       default=env.get("forecast_lead_s", 180.0),
+                       help="seconds ahead the forecast window starts")
+        p.add_argument("--forecast-ttl", type=float, dest="forecast_ttl_s",
+                       default=env.get("forecast_ttl_s", 600.0),
+                       help="headroom placeholder lifetime in seconds")
+        p.add_argument("--forecast-model",
+                       choices=("ewma", "holtwinters"),
+                       default=env.get("forecast_model", "holtwinters"),
+                       help="demand forecaster")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -129,6 +163,11 @@ class Options:
             enable_profiling=ns.enable_profiling,
             log_format=ns.log_format,
             trace_slow_ms=ns.trace_slow_ms,
+            forecast_cadence_s=ns.forecast_cadence_s,
+            forecast_horizon_s=ns.forecast_horizon_s,
+            forecast_lead_s=ns.forecast_lead_s,
+            forecast_ttl_s=ns.forecast_ttl_s,
+            forecast_model=ns.forecast_model,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
@@ -136,6 +175,8 @@ class Options:
         _parse_kv_list(str(env.get("tags", "")), opts.tags)
         if ns.lp_refinery:
             opts.feature_gates["LPRefinery"] = True
+        if ns.forecast:
+            opts.feature_gates["Forecast"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts
@@ -154,6 +195,14 @@ class Options:
             "metrics_port": int,
             "health_port": int,
             "trace_slow_ms": float,
+            "forecast_cadence_s": float,
+            "forecast_horizon_s": float,
+            "forecast_lead_s": float,
+            "forecast_ttl_s": float,
+            "forecast_bucket_s": float,
+            "forecast_confidence": float,
+            "forecast_max_cost_frac": float,
+            "forecast_season_s": float,
         }
         for f in fields(Options):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())

@@ -98,7 +98,11 @@ class SimHarness:
     """One scenario replay over the real controller stack."""
 
     def __init__(self, scenario: Scenario, seed: int = 0,
-                 duration_s: Optional[float] = None):
+                 duration_s: Optional[float] = None,
+                 forecast: Optional[bool] = None):
+        """`forecast` overrides the scenario's forecast.enabled so A/B
+        comparisons (bench, the slow forecast test) can replay one scenario
+        twice — knobs still come from the scenario's forecast block."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -119,6 +123,20 @@ class SimHarness:
         opts = Options(interruption_queue="sim-interruptions",
                        batch_idle_duration=scenario.batch_idle_s,
                        batch_max_duration=scenario.batch_max_s)
+        fc = scenario.forecast
+        fc_on = forecast if forecast is not None \
+            else (fc is not None and fc.enabled)
+        if fc_on:
+            opts.feature_gates["Forecast"] = True
+            if fc is not None:
+                opts.forecast_horizon_s = fc.horizon_s
+                opts.forecast_lead_s = fc.lead_s
+                opts.forecast_ttl_s = fc.ttl_s
+                opts.forecast_bucket_s = fc.bucket_s
+                opts.forecast_confidence = fc.confidence
+                opts.forecast_max_cost_frac = fc.max_cost_frac
+                opts.forecast_model = fc.model
+                opts.forecast_season_s = fc.season_s
         queue = FakeQueue(clock=self.clock)
         cloud = FakeCloud(clock=self.clock, queue=queue)
         cloud.subnets = [SubnetInfo(f"s-{z}", z, 1_000_000, {})

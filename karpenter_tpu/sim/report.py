@@ -35,16 +35,23 @@ def _r(x: float, digits: int = 4) -> float:
 
 def build_report(harness) -> Dict:
     """Assemble the report from a finished `SimHarness`."""
+    from ..forecast.headroom import is_headroom
     sc = harness.scenario
     binds: List[float] = sorted(harness._bind_t.values())
     arrived = len(harness._arrive_t)
     bound = len(binds)
     # pods placed on a node still booting at sim end never started running:
     # they are pending, not bound (their bind clock stops at NodeReady)
+    # — headroom placeholders are capacity reservations, not workload, so
+    # they never count as pending (with forecast off this filter is a no-op
+    # and every pre-forecast report is byte-identical)
     still_booting = sum(
         1 for uids in harness._booting.values() for uid in uids
-        if uid not in harness._bind_t and uid in harness.cluster.pods)
-    pending_at_end = len(harness.cluster.pending_pods()) + still_booting
+        if uid not in harness._bind_t and uid in harness.cluster.pods
+        and not is_headroom(harness.cluster.pods[uid]))
+    pending_at_end = sum(
+        1 for p in harness.cluster.pending_pods()
+        if not is_headroom(p)) + still_booting
     slo = sc.slo_bind_s
     late = sum(1 for b in binds if b > slo)
     # pods that never bound and are still waiting (or left unbound) breach
@@ -65,7 +72,7 @@ def build_report(harness) -> Dict:
     virtual = harness.clock.now() - sc.start_s
     virtual_h = virtual / 3600.0 if virtual > 0 else 1.0
 
-    return {
+    report = {
         "scenario": sc.name,
         "seed": harness.seed,
         "virtual_seconds": _r(virtual, 3),
@@ -120,6 +127,13 @@ def build_report(harness) -> Dict:
             "tick_exceptions": harness._tick_exceptions,
         },
     }
+    forecast = harness.mgr.controllers.get("forecast")
+    if forecast is not None:
+        # present ONLY when the Forecast gate ran — reports without the
+        # gate (every existing golden) carry no forecast section at all
+        report["forecast"] = {k: forecast.stats[k]
+                              for k in sorted(forecast.stats)}
+    return report
 
 
 def report_to_json(report: Dict) -> str:

@@ -58,6 +58,7 @@ DEFAULT_SIM_INTERVALS: Dict[str, float] = {
     "nodeclass": 3600.0,
     "interruption": 5.0,
     "pricing": 600.0,
+    "forecast": 300.0,
 }
 
 
@@ -181,6 +182,34 @@ class Fault:
 
 
 @dataclass
+class ForecastSpec:
+    """Forecast/headroom configuration for a scenario (docs/forecast.md).
+    `enabled: true` turns the Forecast gate on for the simulated operator;
+    the knobs map 1:1 onto the forecast_* Options fields."""
+    enabled: bool = True
+    horizon_s: float = 900.0
+    lead_s: float = 180.0
+    ttl_s: float = 600.0
+    bucket_s: float = 60.0
+    confidence: float = 1.64
+    max_cost_frac: float = 0.10
+    model: str = "holtwinters"
+    season_s: float = 86_400.0
+
+    def validate(self) -> None:
+        for fld in ("horizon_s", "lead_s", "ttl_s", "bucket_s",
+                    "confidence", "season_s"):
+            if getattr(self, fld) <= 0:
+                raise ScenarioError(f"forecast: {fld} must be positive")
+        if not 0.0 < self.max_cost_frac <= 1.0:
+            raise ScenarioError("forecast: max_cost_frac must be in (0, 1]")
+        if self.model not in ("ewma", "holtwinters"):
+            raise ScenarioError(
+                f"forecast: unknown model {self.model!r} "
+                "(expected ewma or holtwinters)")
+
+
+@dataclass
 class Scenario:
     name: str
     duration_s: float = 86_400.0
@@ -198,6 +227,8 @@ class Scenario:
         default_factory=lambda: dict(DEFAULT_SIM_INTERVALS))
     workload: List[Wave] = field(default_factory=list)
     faults: List[Fault] = field(default_factory=list)
+    # proactive headroom provisioning (None = Forecast gate stays off)
+    forecast: Optional[ForecastSpec] = None
 
     def validate(self) -> None:
         if not self.name:
@@ -217,6 +248,8 @@ class Scenario:
             w.validate()
         for f in self.faults:
             f.validate()
+        if self.forecast is not None:
+            self.forecast.validate()
         names = [w.name for w in self.workload]
         if len(set(names)) != len(names):
             raise ScenarioError(f"duplicate wave names: {names}")
@@ -248,6 +281,11 @@ _FAULT_FIELDS = {
     "duration_s": float, "factor": float, "jitter": float,
     "latency_s": float,
 }
+_FORECAST_FIELDS = {
+    "enabled": bool, "horizon_s": float, "lead_s": float, "ttl_s": float,
+    "bucket_s": float, "confidence": float, "max_cost_frac": float,
+    "model": str, "season_s": float,
+}
 
 
 def _coerce(ctx: str, doc: Dict, schema: Dict) -> Dict:
@@ -276,7 +314,7 @@ def scenario_from_dict(doc: Dict) -> Scenario:
         raise ScenarioError(f"scenario document must be a mapping, "
                             f"got {type(doc).__name__}")
     known = {"name", "zones", "intervals", "workload", "faults",
-             *_SCENARIO_SCALARS}
+             "forecast", *_SCENARIO_SCALARS}
     for key in doc:
         if key not in known:
             raise ScenarioError(f"unknown scenario field {key!r} "
@@ -317,6 +355,15 @@ def scenario_from_dict(doc: Dict) -> Scenario:
             fkw["pools"] = [tuple(str(x) for x in p) for p in f["pools"]]
         faults.append(Fault(**fkw))
     kw["faults"] = faults
+    if doc.get("forecast") is not None:
+        fdoc = doc["forecast"]
+        if not isinstance(fdoc, dict):
+            raise ScenarioError("forecast must be a mapping")
+        for key in fdoc:
+            if key not in _FORECAST_FIELDS:
+                raise ScenarioError(f"forecast: unknown field {key!r}")
+        kw["forecast"] = ForecastSpec(
+            **_coerce("forecast", fdoc, _FORECAST_FIELDS))
     sc = Scenario(**kw)
     sc.validate()
     return sc

@@ -41,6 +41,10 @@ class Cluster:
         self.nodeclaims: Dict[str, NodeClaim] = {}
         self.pods: Dict[str, Pod] = {}          # uid -> pod (all known pods)
         self.pdbs: Dict[str, PodDisruptionBudget] = {}
+        # optional demand observer (forecast/series.py DemandSeries): gets
+        # pod_added/pod_removed/pod_bound callbacks under the caller's
+        # state lock; None unless the Forecast gate wires one
+        self.observer = None
 
     # ---- pods ----
     def add_pod(self, pod: Pod) -> Pod:
@@ -52,16 +56,20 @@ class Cluster:
         # them — every later tensorize of this object hits the caches
         _class_key(pod)
         pod_is_soft(pod)
+        if self.observer is not None:
+            self.observer.pod_added(pod)
         return pod
 
     def add_pods(self, pods: Sequence[Pod]) -> List[Pod]:
         return [self.add_pod(p) for p in pods]
 
     def delete_pod(self, pod: Pod):
-        self.pods.pop(pod.uid, None)
+        existed = self.pods.pop(pod.uid, None) is not None
         if pod.node_name and pod.node_name in self.nodes:
             node = self.nodes[pod.node_name]
             node.pods = [p for p in node.pods if p.uid != pod.uid]
+        if existed and self.observer is not None:
+            self.observer.pod_removed(pod)
 
     def bind_pod(self, pod: Pod, node_name: str):
         rebind = bool(pod.node_name)
@@ -88,6 +96,8 @@ class Cluster:
                 pod.__dict__["_startup_observed"] = True
                 metrics.pods_startup_time().observe(
                     max(0.0, self.clock() - pod.created_at))
+        if not rebind and self.observer is not None:
+            self.observer.pod_bound(pod)
 
     def unbind_pod(self, pod: Pod):
         if pod.node_name and pod.node_name in self.nodes:
@@ -119,6 +129,8 @@ class Cluster:
                 # pods are gone for good (termination semantics)
                 if not p.owner_kind:
                     self.pods.pop(p.uid, None)
+                    if self.observer is not None:
+                        self.observer.pod_removed(p)
             node.pods = []
         return node
 

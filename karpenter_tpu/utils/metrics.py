@@ -860,3 +860,55 @@ def sim_reclaim_honor_rate() -> Gauge:
         "karpenter_sim_reclaim_warning_honor_rate",
         "Fraction of scheduled reclaims drained before their deadline in "
         "the last simulation run.")
+
+
+# ---------------------------------------------------------------------------
+# Forecast families (karpenter_tpu/forecast) — populated only with the
+# Forecast gate on; zero-sample otherwise like any pre-registered family.
+# ---------------------------------------------------------------------------
+
+def forecast_demand_upper() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_forecast_demand_upper",
+        "Upper confidence band of forecast demand (pod concurrency) over "
+        "the headroom window, per pod class.",
+        labels=("pod_class",))
+
+
+def forecast_headroom_pods() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_forecast_headroom_pods",
+        "Live headroom placeholder pods (pending + bound, unexpired).")
+
+
+def forecast_placeholders() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_forecast_placeholders_total",
+        "Headroom placeholder lifecycle transitions, by outcome "
+        "(issued | trimmed | expired | preempted).",
+        labels=("outcome",))
+
+
+def forecast_spot_risk() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_forecast_spot_risk",
+        "Posterior spot reclaim rate (reclaims per spot node-hour) per "
+        "nodepool, from the headroom controller's risk prior.",
+        labels=("nodepool",))
+
+
+def forecast_model_residual() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_forecast_model_residual",
+        "Absolute one-step forecast residual (pods) per reconcile, by "
+        "model — the online goodness-of-fit signal.",
+        labels=("model",),
+        buckets=(0.5, 1, 2, 5, 10, 25, 50, 100))
+
+
+def forecast_series_observations() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_forecast_series_observations_total",
+        "Demand-series observations ingested from the cluster observer "
+        "hook, by kind (arrival | departure | bind).",
+        labels=("kind",))

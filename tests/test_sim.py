@@ -236,6 +236,9 @@ GOLDEN_CASES = [
     ("diurnal", "diurnal.yaml", 7200.0),
     ("spot-reclaim-storm", "spot-reclaim-storm.yaml", 7200.0),
     ("ice-starvation", "ice-starvation.yaml", 5400.0),
+    ("diurnal-forecast", "diurnal-forecast.yaml", 7200.0),
+    ("spot-reclaim-storm-forecast", "spot-reclaim-storm-forecast.yaml",
+     7200.0),
 ]
 
 

@@ -47,6 +47,12 @@ def main(argv=None) -> int:
     for kind in sorted(by_kind):
         print(f"  {kind}: {by_kind[kind]}")
     print(f"pods arriving: {pods}")
+    if sc.forecast is not None:
+        fc = sc.forecast
+        state = "on" if fc.enabled else "off"
+        print(f"forecast: {state} ({fc.model}, horizon {fc.horizon_s:.0f}s, "
+              f"lead {fc.lead_s:.0f}s, ttl {fc.ttl_s:.0f}s, "
+              f"season {fc.season_s:.0f}s, z={fc.confidence:g})")
     return 0
 
 
