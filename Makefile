@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test benchmark bench-smoke bench-consolidation bench-sim bench-forecast benchmark-interruption trace-demo sim-demo deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast benchmark-interruption trace-demo sim-demo deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -12,6 +12,9 @@ test: ## Unit/behavior suites (virtual 8-device CPU mesh)
 
 scale-test: ## The in-process scale suite only
 	$(PYTEST) tests/test_scale.py -q
+
+lint-analysis: ## graftlint static analysis (docs/static-analysis.md); fails on non-baselined findings
+	python tools/graftlint.py --fix-hints
 
 benchmark: ## Headline solve benchmark (one JSON line on stdout)
 	python bench.py

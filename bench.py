@@ -385,6 +385,31 @@ def run_interruption_benchmark(sizes=(100, 1000, 5000, 15000)):
             f"({r['seconds']}s, fleet={r['recycled_nodes']})")
 
 
+def _backend_fields(platform):
+    """Backend provenance for every JSON tail: what the orchestrator asked
+    for (`auto` = subprocess discovery), what the child actually ran on,
+    and why they differ when they do.  `platform`/`fallback` stay as the
+    legacy names existing consumers parse."""
+    fallback = os.environ.get("KARPENTER_TPU_BENCH_FALLBACK")
+    return {
+        "backend_requested": os.environ.get(
+            "KARPENTER_TPU_BENCH_REQUESTED", "auto"),
+        "backend_used": platform,
+        "fallback_reason": fallback,
+        "platform": platform,
+        "fallback": fallback,
+    }
+
+
+def _emit(tail, platform):
+    """Print the run's single JSON line with backend provenance spliced in
+    — every emit path goes through here so no config can drop the
+    backend_requested/backend_used/fallback_reason contract."""
+    doc = dict(tail)
+    doc.update(_backend_fields(platform))
+    print(json.dumps(doc), flush=True)
+
+
 _PROBE_CACHE: dict = {}
 
 
@@ -450,6 +475,12 @@ def main():
     forwarded to the child via KARPENTER_TPU_BENCH_FALLBACK so the reason
     appears in the JSON tail, not just buried in stderr."""
     from __graft_entry__ import _virtual_cpu_env
+    # requested backend: an explicit JAX_PLATFORMS pin, else "auto"
+    # (subprocess discovery) — recorded so the JSON tail can state what
+    # was asked for independently of what the child actually got
+    requested = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() \
+        or "auto"
+    os.environ["KARPENTER_TPU_BENCH_REQUESTED"] = requested
     plat = _probe_backend()
     if plat is not None:
         log(f"backend probe: {plat} ok")
@@ -462,6 +493,7 @@ def main():
         reason = "backend probe failed (bounded timeout)"
         log(f"{reason} — falling back to cpu platform")
     env = _virtual_cpu_env(n_devices=1)
+    env["KARPENTER_TPU_BENCH_REQUESTED"] = requested
     env["KARPENTER_TPU_BENCH_FALLBACK"] = reason
     rc = _run_child(env)
     sys.exit(1 if rc is None else rc)
@@ -471,7 +503,6 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
-    fallback = os.environ.get("KARPENTER_TPU_BENCH_FALLBACK")
     rng = np.random.default_rng(42)
 
     if forecast:
@@ -498,13 +529,11 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
         c_on = reports[True]["cost"]["dollar_hours"]
         improvement = (p_off - p_on) / p_off if p_off else 0.0
         cost_delta = (c_on - c_off) / c_off if c_off else 0.0
-        print(json.dumps({
+        _emit({
             "metric": "diurnal-forecast A/B time-to-bind p95 improvement",
             "value": round(100.0 * improvement, 1),
             "unit": "%",
             "vs_baseline": round(improvement / 0.30, 3),
-            "platform": platform,
-            "fallback": fallback,
             "forecast_ttb_p95_improvement": round(improvement, 4),
             "forecast_cost_delta_pct": round(100.0 * cost_delta, 2),
             "forecast_ttb_p95_off_s": p_off,
@@ -512,7 +541,7 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
             "forecast_dollar_hours_off": c_off,
             "forecast_dollar_hours_on": c_on,
             "forecast_stats": reports[True].get("forecast"),
-        }), flush=True)
+        }, platform)
         return
 
     if sim:
@@ -531,20 +560,18 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
             f"/{rep['workload']['pods_arrived']} "
             f"cost={rep['cost']['dollar_hours']:.1f}$h "
             f"tick_exceptions={rep['errors']['tick_exceptions']}")
-        print(json.dumps({
+        _emit({
             "metric": "sim-diurnal-24h virtual-time speedup",
             "value": round(run.speedup, 1),
             "unit": "x",
             "vs_baseline": round(run.speedup / 1000.0, 3),
-            "platform": platform,
-            "fallback": fallback,
             "sim_virtual_seconds": round(run.virtual_seconds, 1),
             "sim_wall_seconds": round(run.wall_seconds, 2),
             "sim_events_delivered": run.events_delivered,
             "sim_pods_bound": rep["workload"]["pods_bound"],
             "sim_slo_violations": rep["slo"]["violations"],
             "sim_dollar_hours": rep["cost"]["dollar_hours"],
-        }), flush=True)
+        }, platform)
         return
 
     if consolidation:
@@ -554,11 +581,9 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
         tail = {"metric": "500-node consolidation sweep (100-candidate "
                           "warm) p50 latency",
                 "value": cons.get("sweep_p50_ms_100"),
-                "unit": "ms",
-                "platform": platform,
-                "fallback": fallback}
+                "unit": "ms"}
         tail.update({f"consolidation_{k}": v for k, v in cons.items()})
-        print(json.dumps(tail), flush=True)
+        _emit(tail, platform)
         return
 
     if smoke:
@@ -570,11 +595,9 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
             "metric": "1k-pod x 10-type end-to-end schedule (smoke) p50 latency",
             "value": round(p50, 2),
             "unit": "ms",
-            "platform": platform,
-            "fallback": fallback,
         }
         smoke_tail.update(tstats)
-        print(json.dumps(smoke_tail), flush=True)
+        _emit(smoke_tail, platform)
         return
 
     # config 1: 1k homogeneous CPU pods, 10 types
@@ -605,15 +628,13 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
         "value": round(p50, 2),
         "unit": "ms",
         "vs_baseline": round(baseline_ms / p50, 3),
-        "platform": platform,
         "cold_p50_ms_10k": None if cold10_p50 is None else round(cold10_p50, 2),
         "stale_p50_ms_10k": None if stale10_p50 is None else round(stale10_p50, 2),
         "warm_p50_ms_10k": round(warm10_p50, 2),
-        "fallback": fallback,
     }
     tail.update(tstats)
     tail.update({f"consolidation_{k}": v for k, v in cons.items()})
-    print(json.dumps(tail), flush=True)
+    _emit(tail, platform)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,32 @@
+"""graftlint: codebase-aware static analysis for karpenter-tpu.
+
+Four checker families tuned to this repo's correctness regimes:
+
+  * jax-hotpath (JH*)      — host-device syncs, tracer branching, dynamic
+    static_argnums, missing buffer donation in the `ops/` kernels.
+  * determinism (DT*)      — wall-clock reads, unseeded global RNG, and
+    unordered set iteration in modules reachable from `sim/` (the golden
+    reports are byte-identical; any of these breaks them).
+  * lock-discipline (LK*)  — `# guarded-by: <lock>` annotations on shared
+    attributes, checked lexically; plus a test-time lock-order recorder
+    (analysis/lockorder.py) that fails the suite on observed inversions.
+  * observability (OB*)    — metrics families ↔ docs/metrics.md contract,
+    bounded label sets, span names drawn from utils/tracing.SPAN_NAMES.
+
+Entry points: `tools/graftlint.py` CLI, `make lint-analysis`, and the
+tier-1 gate in tests/test_graftlint.py (zero non-baselined findings).
+See docs/static-analysis.md for the conventions and baseline workflow.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Rule,
+    RULES,
+    SourceFile,
+    default_checkers,
+    iter_sources,
+    load_baseline,
+    partition,
+    run_analysis,
+    write_baseline,
+)

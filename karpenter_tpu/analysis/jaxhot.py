@@ -1,0 +1,210 @@
+"""jax-hotpath checker family (JH*).
+
+The solver hot path is a set of `@partial(jax.jit, ...)` kernels under
+`ops/` and `parallel/` fed by tensorize; the disciplines that keep them
+fast are exactly the ones that silently rot: no host-device syncs inside
+the window (`.item()`, `float()` / `np.asarray` on traced values,
+`.block_until_ready()` belongs in bench code only), no Python branching
+on tracers (works under `jit` only until the branch actually depends on
+data, then dies at trace time — or worse, constant-folds), static
+argument specs that stay literal (a dynamic `static_argnums` turns every
+call into a fresh trace), and donation of the scratch buffers the scan
+kernels consume (missed donation = one extra device copy per solve).
+
+Detection is scoped to where the rule is meaningful: JH001/JH002 to the
+hot modules (`ops/`, `parallel/`), JH003/JH005/JH006 to jit-decorated
+functions anywhere, JH004 to any jit spec.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core import Checker, Finding, SourceFile, rule
+
+rule("JH001", "jax-hotpath",
+     ".item() host-device sync in a hot-path module",
+     "keep the value on device; decode once at the host boundary with "
+     "np.asarray over the whole result batch")
+rule("JH002", "jax-hotpath",
+     ".block_until_ready() outside bench code",
+     "remove it — only bench.py timing loops need an explicit barrier; "
+     "the decode's np.asarray is already a sync point")
+rule("JH003", "jax-hotpath",
+     "Python branch on a traced value inside a jit function",
+     "replace `if`/`while` on a traced array with jnp.where / lax.cond / "
+     "lax.while_loop, or mark the argument static if it is host data")
+rule("JH004", "jax-hotpath",
+     "dynamic or non-literal static_argnums/static_argnames",
+     "static specs must be literal ints/strings (or tuples of them); a "
+     "computed spec retraces per call and an unhashable one raises")
+rule("JH005", "jax-hotpath",
+     "jit kernel consumes scratch buffers without donating them",
+     "add donate_argnames for init_*/scratch buffers the kernel overwrites "
+     "— or baseline this finding when the caller reuses the buffer "
+     "(the arena cache does)")
+rule("JH006", "jax-hotpath",
+     "host conversion (float/int/np.asarray) of a traced value inside jit",
+     "move the conversion outside the jit boundary or keep the math in "
+     "jnp; inside a trace this forces a concretization error or a sync")
+
+HOT_PREFIXES = ("karpenter_tpu/ops/", "karpenter_tpu/parallel/")
+_HOST_CONVERTERS = {"float", "int", "bool"}
+_NP_CONVERTERS = {"asarray", "array"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """`jax.jit` or bare `jit` as an expression."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit" and \
+            isinstance(node.value, ast.Name) and node.value.id == "jax":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_of(deco: ast.AST) -> Optional[ast.Call]:
+    """The `partial(jax.jit, ...)` / `jax.jit(...)` call of a decorator,
+    or None when the decorator is a bare `@jax.jit`."""
+    if isinstance(deco, ast.Call):
+        if _is_jax_jit(deco.func):
+            return deco
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+        fn = deco.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else ""
+        if name == "partial" and deco.args and _is_jax_jit(deco.args[0]):
+            return deco
+    return None
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> Optional[ast.Call]:
+    """Returns the jit spec call for a jit-decorated function (a synthetic
+    empty call for bare `@jax.jit`), else None."""
+    for deco in fn.decorator_list:
+        if _is_jax_jit(deco):
+            return ast.Call(func=deco, args=[], keywords=[])
+        call = _jit_call_of(deco)
+        if call is not None:
+            return call
+    return None
+
+
+def _literal_spec(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, str))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_literal_spec(e) for e in node.elts)
+    return False
+
+
+def _static_names(call: ast.Call, fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names made static by the spec (literal specs only)."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for s in ast.walk(kw.value):
+                if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                    out.add(s.value)
+        elif kw.arg == "static_argnums":
+            for s in ast.walk(kw.value):
+                if isinstance(s, ast.Constant) and isinstance(s.value, int) \
+                        and 0 <= s.value < len(params):
+                    out.add(params[s.value])
+    return out
+
+
+class JaxHotPathChecker(Checker):
+    family = "jax-hotpath"
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        hot = sf.rel.startswith(HOT_PREFIXES)
+        for node in ast.walk(sf.tree):
+            # JH001/JH002: sync calls, anywhere in hot modules
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                if hot and node.func.attr == "item" and not node.args:
+                    findings.append(Finding(
+                        "JH001", sf.rel, node.lineno, sf.scope_of(node),
+                        "item", ".item() forces a host-device sync"))
+                if node.func.attr == "block_until_ready":
+                    findings.append(Finding(
+                        "JH002", sf.rel, node.lineno, sf.scope_of(node),
+                        "block_until_ready",
+                        ".block_until_ready() barrier outside bench code"))
+            # JH004: static spec must be literal — any jit call expression
+            if isinstance(node, ast.Call):
+                call = node if _is_jax_jit(node.func) else _jit_call_of(node)
+                if call is not None:
+                    for kw in call.keywords:
+                        if kw.arg in ("static_argnums", "static_argnames") \
+                                and not _literal_spec(kw.value):
+                            findings.append(Finding(
+                                "JH004", sf.rel, kw.value.lineno,
+                                sf.scope_of(node), kw.arg,
+                                f"non-literal {kw.arg} spec retraces "
+                                "per call"))
+            # per-jit-function rules
+            if isinstance(node, ast.FunctionDef):
+                spec = _is_jit_decorated(node)
+                if spec is not None:
+                    findings.extend(self._check_jit_fn(sf, node, spec))
+        return findings
+
+    def _check_jit_fn(self, sf: SourceFile, fn: ast.FunctionDef,
+                      spec: ast.Call) -> List[Finding]:
+        findings: List[Finding] = []
+        static = _static_names(spec, fn)
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args +
+                  fn.args.kwonlyargs}
+        traced = params - static
+
+        def names_in(node: ast.AST) -> Set[str]:
+            return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+        for node in ast.walk(fn):
+            # JH003: if/while whose test reads a traced parameter.  Only the
+            # OUTER jit function's params are known-traced; nested scan-step
+            # closures rebind their own names and are left to fixtures.
+            if isinstance(node, (ast.If, ast.While)):
+                hit = names_in(node.test) & traced
+                if hit:
+                    findings.append(Finding(
+                        "JH003", sf.rel, node.lineno, sf.scope_of(node),
+                        ",".join(sorted(hit)),
+                        f"branch on traced value(s) {sorted(hit)} inside "
+                        f"jit function {fn.name}"))
+            # JH006: host conversion applied to a traced parameter
+            if isinstance(node, ast.Call) and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in traced:
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in _HOST_CONVERTERS:
+                    conv = f.id
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr in _NP_CONVERTERS and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ("np", "numpy"):
+                    conv = f"np.{f.attr}"
+                else:
+                    continue
+                findings.append(Finding(
+                    "JH006", sf.rel, node.lineno, sf.scope_of(node),
+                    f"{conv}:{node.args[0].id}",
+                    f"{conv}({node.args[0].id}) concretizes a traced value "
+                    f"inside jit function {fn.name}"))
+
+        # JH005: scratch-buffer params (init_* naming convention shared by
+        # the scan kernels) without donation in the spec
+        scratch = sorted(p for p in traced if p.startswith("init_"))
+        if scratch:
+            donated = any(kw.arg in ("donate_argnums", "donate_argnames")
+                          for kw in spec.keywords)
+            if not donated:
+                findings.append(Finding(
+                    "JH005", sf.rel, fn.lineno, sf.scope_of(fn),
+                    ",".join(scratch),
+                    f"kernel {fn.name} consumes scratch buffers "
+                    f"{scratch} without donate_argnames"))
+        return findings

@@ -89,11 +89,12 @@ class Batcher(Generic[Req, Res]):
     """Generic windowed batcher (batcher.go:52-197)."""
 
     def __init__(self, options: Options, clock: Callable[[], float] = time.monotonic):
+        from ..analysis.lockorder import named_lock
         self.options = options
         self.clock = clock
-        self.stats = BatchStats()
-        self._lock = threading.Lock()
-        self._open: Dict[Hashable, _Bucket] = {}
+        self._lock = named_lock("batcher")
+        self.stats = BatchStats()               # guarded-by: _lock
+        self._open: Dict[Hashable, _Bucket] = {}  # guarded-by: _lock
 
     def add(self, request: Req) -> Res:
         """Join the open window for this request's hash (opening one and its
@@ -120,8 +121,7 @@ class Batcher(Generic[Req, Res]):
             raise bucket.error
         return bucket.results[idx]
 
-    def _close(self, key: Hashable, bucket: _Bucket) -> None:
-        # caller holds self._lock
+    def _close(self, key: Hashable, bucket: _Bucket) -> None:  # graftlint: holds(_lock)
         if not bucket.closed:
             bucket.closed = True
             bucket.closed_event.set()

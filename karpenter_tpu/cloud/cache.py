@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
+from ..analysis.lockorder import named_lock
+
 UNAVAILABLE_OFFERINGS_TTL = 3 * 60.0  # seconds (reference: 3m, pkg/cache/cache.go)
 
 
@@ -23,8 +25,8 @@ class TTLCache:
     def __init__(self, default_ttl: float, clock: Callable[[], float] = time.time):
         self.default_ttl = default_ttl
         self.clock = clock
-        self._data: Dict[Any, Tuple[float, Any]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("ttlcache")
+        self._data: Dict[Any, Tuple[float, Any]] = {}  # guarded-by: _lock
 
     def set(self, key, value, ttl: Optional[float] = None):
         expires = self.clock() + (self.default_ttl if ttl is None else ttl)
@@ -84,8 +86,8 @@ class UnavailableOfferings:
     def __init__(self, ttl: float = UNAVAILABLE_OFFERINGS_TTL,
                  clock: Callable[[], float] = time.time):
         self._cache = TTLCache(ttl, clock)
-        self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("unavailable.seq")
+        self._seq = 0                           # guarded-by: _lock
 
     @staticmethod
     def key(capacity_type: str, instance_type: str, zone: str) -> str:

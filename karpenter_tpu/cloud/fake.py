@@ -123,9 +123,10 @@ class FakeCloud:
 
     def __init__(self, clock: Callable[[], float] = time.time,
                  queue: Optional["FakeQueue"] = None):
+        from ..analysis.lockorder import named_lock
         self.clock = clock
-        self._lock = threading.RLock()
-        self._instances: Dict[str, CloudInstance] = {}
+        self._lock = named_lock("cloud", threading.RLock)
+        self._instances: Dict[str, CloudInstance] = {}  # guarded-by: _lock
         self._ids = itertools.count(1)
         # (capacity_type, instance_type, zone) pools that ICE
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
@@ -142,7 +143,7 @@ class FakeCloud:
         # clock-scheduled deliveries: (at, seq, action, instance_id) heap,
         # drained by deliver_due() — the virtual-time interruption pipeline
         # (warning at T-120, reclaim at T)
-        self._scheduled: List[Tuple[float, int, str, str]] = []
+        self._scheduled: List[Tuple[float, int, str, str]] = []  # guarded-by: _lock
         self._sched_seq = itertools.count(1)
         # every API call fails with RequestLimitExceeded while
         # clock() < throttle_until (API throttle burst injection)

@@ -110,11 +110,12 @@ class FakeQueue:
     messages stay in flight until deleted; undeleted messages reappear."""
 
     def __init__(self, clock: Callable[[], float] = time.time):
+        from ..analysis.lockorder import named_lock
         self.clock = clock
-        self._lock = threading.Lock()
-        self._messages: List[Message] = []
-        self._inflight: Dict[str, Message] = {}
-        self.sent_count = 0
+        self._lock = named_lock("queue")
+        self._messages: List[Message] = []      # guarded-by: _lock
+        self._inflight: Dict[str, Message] = {}  # guarded-by: _lock
+        self.sent_count = 0                     # guarded-by: _lock
 
     def send(self, body: str) -> Message:
         msg = Message(body=body, sent_at=self.clock())

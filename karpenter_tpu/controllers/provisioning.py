@@ -160,7 +160,9 @@ class Provisioner:
         # usage covers every pool with LIVE capacity — including pools
         # removed from config mid-drain, which still hold launched resources
         # (nodes_total keeps those series too; the two families must agree)
-        for pool_name in set(usage) | set(self.nodepools):
+        # sorted: sample emission order must not depend on set hashing
+        # (graftlint DT003 — /metrics exposition is byte-compared in tests)
+        for pool_name in sorted(set(usage) | set(self.nodepools)):
             for res, qty in usage.get(pool_name, ResourceList()).items():
                 usage_g.set(qty, {"nodepool": pool_name, "resource_type": res})
                 cur_u.add((pool_name, res))
@@ -177,9 +179,9 @@ class Provisioner:
                 out.append(pool)
             else:
                 log.info("nodepool %s at limit, excluded from provisioning", pool.name)
-        for pool_name, res in prev_u - cur_u:
+        for pool_name, res in sorted(prev_u - cur_u):
             usage_g.delete({"nodepool": pool_name, "resource_type": res})
-        for pool_name, res in prev_l - cur_l:
+        for pool_name, res in sorted(prev_l - cur_l):
             limit_g.delete({"nodepool": pool_name, "resource_type": res})
             pct_g.delete({"nodepool": pool_name, "resource_type": res})
         self._usage_gauge_keys = cur_u
@@ -312,9 +314,9 @@ class Provisioner:
         # every known pool gets a sample (0 after draining — not a stale
         # count); series for pools gone from BOTH config and cluster drop
         cur = set(self.nodepools) | set(counts)
-        for pool_name in cur:
+        for pool_name in sorted(cur):   # deterministic sample order (DT003)
             nodes_g.set(counts.get(pool_name, 0), {"nodepool": pool_name})
-        for pool_name in getattr(self, "_nodes_gauge_keys", set()) - cur:
+        for pool_name in sorted(getattr(self, "_nodes_gauge_keys", set()) - cur):
             nodes_g.delete({"nodepool": pool_name})
         self._nodes_gauge_keys = cur
         return out

@@ -141,6 +141,8 @@ class SpotRiskPrior:
 
     def max_rate(self) -> float:
         pools = set(self._reclaims) | set(self._node_hours) | {"default"}
+        # commutative max reduction: order-insensitive
+        # graftlint: disable=DT003
         return max(self.rate(p) for p in pools)
 
 

@@ -122,7 +122,12 @@ int32_t ffd_pack(int32_t P, int32_t R, int32_t O, int32_t E, int32_t K,
         if (!fits) continue;
         if (m < 1.0f) m = 1.0f;
         if ((float)cap < m) m = (float)cap;
-        const float score = price[j] * std::ceil(tail / m);
+        float score = price[j] * std::ceil(tail / m);
+        // overflow clamp, identical to the JAX kernel's SCORE_CAP
+        // (ops/ffd.py): keep float32 math, then cap — the !(<=) form
+        // also catches +inf so clamped candidates stay comparable and
+        // ties break to the lower index on both backends.
+        if (!(score <= 3.38e38f)) score = 3.38e38f;
         if (rank[j] < best_r || best < 0 || score < best_score) {
           best = j;
           best_score = score;

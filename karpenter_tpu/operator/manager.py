@@ -164,7 +164,7 @@ class ControllerManager:
         self.controllers = controllers
         self.clock = clock
         self.leader = leader
-        self.batch_window = PodBatchWindow(
+        self.batch_window = PodBatchWindow(  # guarded-by: caller(_state_lock)
             idle=operator.options.batch_idle_duration,
             max_timeout=operator.options.batch_max_duration,
             clock=clock)
@@ -192,8 +192,9 @@ class ControllerManager:
         # serializes cluster-state access between the tick loop, the /v1
         # worker threads, and the metrics collector — shared with the
         # operator so every reader of cluster state takes the SAME lock
+        from ..analysis.lockorder import named_lock
         self._state_lock = getattr(operator, "state_lock", None) or \
-            threading.Lock()
+            named_lock("state")
 
     def _nodeclass_tick(self, ctrl):
         def run():

@@ -129,8 +129,8 @@ class Operator:
         # one state lock shared by the tick loop (ControllerManager), the
         # /v1 surface, and the metrics collector — scrapes and solves must
         # never iterate cluster state mid-mutation (advisor r4)
-        import threading
-        self.state_lock = threading.Lock()
+        from ..analysis.lockorder import named_lock
+        self.state_lock = named_lock("state")
         # pre-register every parity family so the first scrape serves the
         # complete reference schema (zero samples beat absent families)
         metrics.register_parity_families()
@@ -140,8 +140,8 @@ class Operator:
         metrics.REGISTRY.add_collector(
             metrics.make_cluster_collector(self.cluster,
                                            lock=self.state_lock))
-        self.node_classes: Dict[str, NodeClass] = {"default": NodeClass()}
-        self.nodepools: Dict[str, NodePool] = {"default": NodePool()}
+        self.node_classes: Dict[str, NodeClass] = {"default": NodeClass()}  # guarded-by: caller(state_lock)
+        self.nodepools: Dict[str, NodePool] = {"default": NodePool()}  # guarded-by: caller(state_lock)
         self.cloud_provider = CloudProvider(
             self.batched_cloud, self.catalog, unavailable=self.unavailable,
             node_classes=self.node_classes,

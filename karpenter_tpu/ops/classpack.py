@@ -31,7 +31,7 @@ import numpy as np
 
 from ..api.resources import ResourceList
 from ..utils import tracing
-from .ffd import NodeDecision, PackingResult
+from .ffd import SCORE_CAP, NodeDecision, PackingResult
 from .tensorize import LaunchOption, Problem, pad_to
 
 _BIG = np.int32(2**30)
@@ -102,7 +102,14 @@ def class_pack_kernel(requests: jax.Array,   # C×R int32, classes FFD-sorted
         # reference's "maximize additional pods packed" tie-break
         m_safe = jnp.maximum(m, 1)
         nodes_needed = (jnp.maximum(remaining, 1) + m_safe - 1) // m_safe
-        score = jnp.where(ok, price * nodes_needed.astype(price.dtype), jnp.inf)
+        # clamp before the finiteness test: a viable option whose
+        # price × nodes_needed overflows float32 must stay schedulable
+        # (and comparable) rather than read as "no option fits"
+        score = jnp.where(
+            ok,
+            jnp.minimum(price * nodes_needed.astype(price.dtype),
+                        jnp.asarray(SCORE_CAP, price.dtype)),
+            jnp.inf)
         j = jnp.argmin(score)                               # ties → cheapest (pre-sorted)
         can = jnp.isfinite(score[j])
         m_sel = jnp.maximum(m[j], 1)

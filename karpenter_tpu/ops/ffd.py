@@ -31,6 +31,14 @@ from .tensorize import LaunchOption, Problem, pad_to
 
 NO_ASSIGNMENT = -1
 
+# Cap on new-node scores (price × ceil(tail/m)): large-but-finite prices
+# times a big tail overflow float32 to +inf, which argmin-over-all-inf
+# resolves to index 0 — possibly an incompatible option — while `can_new`
+# still says yes.  Clamping keeps overflowed candidates comparable (ties
+# break to the lower, cheaper-sorted index) and MUST match the native
+# kernel's clamp (csrc/ffd.cc) bit-for-bit for backend parity.
+SCORE_CAP = 3.38e38  # just under float32 max (3.4028e38)
+
 
 @partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
@@ -84,8 +92,9 @@ def ffd_pack_kernel(requests: jax.Array,    # P×R, FFD-sorted
                               jnp.floor(alloc / safe_req[None, :]),
                               jnp.float32(2**30)), axis=-1)
         m = jnp.clip(m, 1.0, jnp.maximum(cap.astype(m.dtype), 1.0))
-        score = price * jnp.ceil(
-            jnp.maximum(tail, 1).astype(price.dtype) / m)
+        score = jnp.minimum(price * jnp.ceil(
+            jnp.maximum(tail, 1).astype(price.dtype) / m),
+            jnp.asarray(SCORE_CAP, price.dtype))
         new_opt = jnp.argmin(jnp.where(new_ok_r, score, jnp.inf))
         can_new = jnp.any(new_ok) & (n_open < K)
         sched_exist = is_valid & any_fit

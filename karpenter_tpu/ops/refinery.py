@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..analysis.lockorder import named_lock
 from ..utils import metrics, tracing
 
 log = logging.getLogger("karpenter_tpu.refinery")
@@ -61,8 +62,8 @@ class GuideRefinery:
         self.clock = clock
         self.monotonic = monotonic
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
-        self._inflight: set = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("refinery.inflight")
+        self._inflight: set = set()     # guarded-by: _lock
         self._stop = threading.Event()
         self._upgrade = threading.Event()
         self._thread: Optional[threading.Thread] = None

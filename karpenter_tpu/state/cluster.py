@@ -37,10 +37,13 @@ NOMINATION_WINDOW_S = 20.0
 class Cluster:
     def __init__(self, clock: Callable[[], float] = time.time):
         self.clock = clock
-        self.nodes: Dict[str, Node] = {}
-        self.nodeclaims: Dict[str, NodeClaim] = {}
-        self.pods: Dict[str, Pod] = {}          # uid -> pod (all known pods)
-        self.pdbs: Dict[str, PodDisruptionBudget] = {}
+        # the cluster has no lock of its own: every mutation happens under
+        # the Operator's state_lock, held by the manager's tick loop, the
+        # /v1 apply surface, and the metrics collector (graftlint LK)
+        self.nodes: Dict[str, Node] = {}        # guarded-by: caller(state_lock)
+        self.nodeclaims: Dict[str, NodeClaim] = {}  # guarded-by: caller(state_lock)
+        self.pods: Dict[str, Pod] = {}          # guarded-by: caller(state_lock)
+        self.pdbs: Dict[str, PodDisruptionBudget] = {}  # guarded-by: caller(state_lock)
         # optional demand observer (forecast/series.py DemandSeries): gets
         # pod_added/pod_removed/pod_bound callbacks under the caller's
         # state lock; None unless the Forecast gate wires one

@@ -31,10 +31,11 @@ def _labels_key(label_names: Sequence[str], values: Dict[str, str]) -> LabelKV:
 
 class _Metric:
     def __init__(self, name: str, help_text: str, label_names: Sequence[str] = ()):
+        from ..analysis.lockorder import named_lock
         self.name = name
         self.help = help_text
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.family")
 
     def _key(self, labels: Optional[Dict[str, str]]) -> LabelKV:
         return _labels_key(self.label_names, labels or {})
@@ -45,7 +46,7 @@ class Counter(_Metric):
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
-        self._values: Dict[LabelKV, float] = {}
+        self._values: Dict[LabelKV, float] = {}  # guarded-by: _lock
 
     def inc(self, labels: Optional[Dict[str, str]] = None, by: float = 1.0):
         if by < 0:
@@ -67,7 +68,7 @@ class Gauge(_Metric):
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
-        self._values: Dict[LabelKV, float] = {}
+        self._values: Dict[LabelKV, float] = {}  # guarded-by: _lock
 
     def set(self, value: float, labels: Optional[Dict[str, str]] = None):
         with self._lock:
@@ -97,9 +98,9 @@ class Histogram(_Metric):
                  buckets: Sequence[float] = DEFAULT_BUCKETS):
         super().__init__(name, help_text, label_names)
         self.buckets = tuple(sorted(buckets))
-        self._counts: Dict[LabelKV, List[int]] = {}
-        self._sums: Dict[LabelKV, float] = {}
-        self._totals: Dict[LabelKV, int] = {}
+        self._counts: Dict[LabelKV, List[int]] = {}  # guarded-by: _lock
+        self._sums: Dict[LabelKV, float] = {}        # guarded-by: _lock
+        self._totals: Dict[LabelKV, int] = {}        # guarded-by: _lock
 
     def observe(self, value: float, labels: Optional[Dict[str, str]] = None):
         key = self._key(labels)
@@ -153,9 +154,10 @@ class Registry:
     """A named collection of metric families with text exposition."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}
-        self._collectors: list = []
+        from ..analysis.lockorder import named_lock
+        self._lock = named_lock("metrics.registry")
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: _lock
+        self._collectors: list = []             # guarded-by: _lock
 
     def add_collector(self, fn) -> None:
         """Register a scrape-time refresher: called (outside the lock) at the
@@ -744,8 +746,9 @@ def make_cluster_collector(cluster, lock=None):
     tick binds or removes raises mid-iteration); a private lock guards
     prev_keys against concurrent scrapes."""
     import contextlib
+    from ..analysis.lockorder import named_lock
     prev_keys: set = set()
-    my_lock = threading.Lock()
+    my_lock = named_lock("metrics.collector")
 
     FAMS = {"a": nodes_allocatable, "o": nodes_system_overhead,
             "r": nodes_pod_requests, "l": nodes_pod_limits,
@@ -790,7 +793,9 @@ def make_cluster_collector(cluster, lock=None):
                 put("l", base, lim)
                 put("dr", base, dreq)
                 put("dl", base, dlim)
-            for kind, name, pool, res in prev_keys - cur:
+            # sorted: stale-series deletion order must not depend on set
+            # hashing (graftlint DT003)
+            for kind, name, pool, res in sorted(prev_keys - cur):
                 gauges[kind].delete({"node_name": name, "nodepool": pool,
                                      "resource_type": res})
             prev_keys = cur

@@ -37,6 +37,39 @@ from . import metrics
 
 logger = logging.getLogger("karpenter.tracing")
 
+# The one span-name registry (graftlint OB004/OB005): every literal
+# `tracing.span("...")` name lives here, so the `span` label set of
+# karpenter_trace_span_duration_seconds stays enumerable and docs/
+# dashboards can list the full vocabulary.  Dynamic names must pass
+# through `registered()`, which asserts membership at runtime.
+SPAN_NAMES = frozenset({
+    # provisioning tick
+    "provision", "provision.round", "provision.launch",
+    "provision.provenance",
+    "solve.tensorize", "solve.pack", "solve.kernel", "solve.decode",
+    # disruption sweep (disruption.<method> from the timed() dispatcher)
+    "disruption.reconcile", "disruption.candidates", "disruption.execute",
+    "disruption.expiration", "disruption.drift", "disruption.consolidation",
+    "sweep.arena", "sweep.prefix", "sweep.decode", "sweep.single",
+    # refinery + LP guide
+    "refinery.refine", "refinery.lp", "refinery.price",
+    # forecast/headroom reconcile
+    "forecast.reconcile", "forecast.model", "forecast.plan",
+    "forecast.preempt",
+    # substrate
+    "batcher.flush", "http.solve",
+})
+
+
+def registered(name: str) -> str:
+    """Runtime gate for dynamically-composed span names: asserts the
+    result is in SPAN_NAMES so a new code path can't mint an unbounded
+    `span` label behind the static checker's back."""
+    if name not in SPAN_NAMES:
+        raise ValueError(f"span name {name!r} is not in tracing.SPAN_NAMES")
+    return name
+
+
 _ids = itertools.count(1)
 _id_lock = threading.Lock()
 
@@ -110,11 +143,12 @@ class Tracer:
     """Thread-local span stacks + a bounded ring of completed root traces."""
 
     def __init__(self, max_traces: int = 256):
+        from ..analysis.lockorder import named_lock
         self.enabled = True
         self.slow_ms = 0.0          # 0 disables slow-span WARNs
         self.max_traces = max_traces
-        self._ring: deque = deque(maxlen=max_traces)
-        self._lock = threading.Lock()
+        self._lock = named_lock("tracer")
+        self._ring: deque = deque(maxlen=max_traces)  # guarded-by: _lock
         self._local = threading.local()
 
     # ---- thread-local stack ----

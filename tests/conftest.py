@@ -22,3 +22,28 @@ except AttributeError:
     # older jax: XLA_FLAGS --xla_force_host_platform_device_count (set
     # above) is the only spelling; it must land before backend init.
     pass
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_recorder():
+    """Record lock-acquisition order across the whole suite and fail at
+    session end if any two named locks were ever taken in both orders
+    (a latent AB/BA deadlock).  Locks created while the recorder is
+    enabled become recording proxies; production runs get plain locks.
+    Kill switch: KARPENTER_TPU_LOCK_ORDER=0."""
+    from karpenter_tpu.analysis.lockorder import RECORDER
+    if os.environ.get("KARPENTER_TPU_LOCK_ORDER", "1") == "0":
+        yield
+        return
+    RECORDER.reset()
+    RECORDER.enabled = True
+    try:
+        yield
+    finally:
+        RECORDER.enabled = False
+        bad = RECORDER.inversions()
+        assert not bad, (
+            "lock-order inversions observed during the test session "
+            "(potential deadlock):\n" + "\n".join(bad))

@@ -1,0 +1,75 @@
+"""bench.py JSON contract: every emitted tail carries explicit backend
+provenance — backend_requested / backend_used / fallback_reason — so a
+silent TPU→CPU fallback can never masquerade as a TPU number."""
+
+import ast
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    sys.path.insert(0, REPO)
+    try:
+        return importlib.import_module("bench")
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_backend_fields_default_auto(bench, monkeypatch):
+    monkeypatch.delenv("KARPENTER_TPU_BENCH_REQUESTED", raising=False)
+    monkeypatch.delenv("KARPENTER_TPU_BENCH_FALLBACK", raising=False)
+    f = bench._backend_fields("tpu")
+    assert f["backend_requested"] == "auto"
+    assert f["backend_used"] == "tpu"
+    assert f["fallback_reason"] is None
+    # legacy names kept for existing consumers
+    assert f["platform"] == "tpu"
+    assert f["fallback"] is None
+
+
+def test_backend_fields_reflect_orchestrator_env(bench, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_BENCH_REQUESTED", "tpu")
+    monkeypatch.setenv("KARPENTER_TPU_BENCH_FALLBACK",
+                       "backend probe failed (bounded timeout)")
+    f = bench._backend_fields("cpu")
+    assert f["backend_requested"] == "tpu"
+    assert f["backend_used"] == "cpu"
+    assert "probe failed" in f["fallback_reason"]
+
+
+def test_emit_splices_backend_fields(bench, monkeypatch, capsys):
+    monkeypatch.setenv("KARPENTER_TPU_BENCH_REQUESTED", "auto")
+    monkeypatch.delenv("KARPENTER_TPU_BENCH_FALLBACK", raising=False)
+    bench._emit({"metric": "m", "value": 1.5, "unit": "ms"}, "cpu")
+    line = capsys.readouterr().out.strip()
+    doc = json.loads(line)
+    assert doc["metric"] == "m" and doc["value"] == 1.5
+    for key in ("backend_requested", "backend_used", "fallback_reason"):
+        assert key in doc
+    assert doc["backend_used"] == "cpu"
+
+
+def test_every_json_emit_goes_through_emit_helper(bench):
+    """Static guard: run_all must not print raw json.dumps tails — the
+    _emit helper is the only place allowed to, so no new config can drop
+    the provenance fields."""
+    with open(os.path.join(REPO, "bench.py"), "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    offenders = []
+    for fn in ast.walk(tree):
+        if not (isinstance(fn, ast.FunctionDef) and fn.name != "_emit"):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "dumps":
+                offenders.append(f"{fn.name}:{node.lineno}")
+    assert not offenders, \
+        f"json.dumps outside _emit (use _emit): {offenders}"

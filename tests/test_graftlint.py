@@ -1,0 +1,591 @@
+"""graftlint suite tests: the repo gate (zero non-baselined findings),
+positive/negative fixtures for each checker family, the lock-order
+recorder, and the CLI surface."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import threading
+import textwrap
+
+import pytest
+
+from karpenter_tpu.analysis import (
+    Finding, RULES, SourceFile, default_checkers, iter_sources,
+    load_baseline, partition, run_analysis)
+from karpenter_tpu.analysis.core import is_suppressed
+from karpenter_tpu.analysis.determinism import DeterminismChecker
+from karpenter_tpu.analysis.jaxhot import JaxHotPathChecker
+from karpenter_tpu.analysis.locks import LockDisciplineChecker
+from karpenter_tpu.analysis.lockorder import (
+    LockOrderRecorder, _RecordingLock, named_lock)
+from karpenter_tpu.analysis.observability import ObservabilityChecker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "graftlint-baseline.json")
+
+
+def _sf(src, rel="karpenter_tpu/sim/mod.py"):
+    text = textwrap.dedent(src)
+    return SourceFile("/virtual/" + rel, rel, text, ast.parse(text))
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# the repo gate — this is the tier-1 enforcement point
+# ---------------------------------------------------------------------------
+
+def test_repo_has_no_new_findings():
+    findings = run_analysis(REPO)
+    baseline = load_baseline(BASELINE)
+    new, old, stale = partition(findings, baseline)
+    assert not new, "non-baselined graftlint findings:\n" + \
+        "\n".join(f.render(fix_hints=True) for f in new)
+    assert not stale, f"stale baseline entries (fixed? prune them): {stale}"
+
+
+def test_baseline_is_committed_and_only_jh005():
+    """The grandfathered set is exactly the un-donated scan-kernel scratch
+    buffers (donation would defeat the arena cache's buffer reuse)."""
+    baseline = load_baseline(BASELINE)
+    assert baseline, "baseline file missing or empty"
+    assert all(k.startswith("JH005|") for k in baseline), sorted(baseline)
+
+
+def test_every_emitted_rule_is_registered():
+    for f in run_analysis(REPO):
+        assert f.rule in RULES
+
+
+# ---------------------------------------------------------------------------
+# jax-hotpath fixtures
+# ---------------------------------------------------------------------------
+
+def test_jh001_item_flagged_only_in_hot_modules():
+    src = """
+        def decode(out):
+            return out.total.item()
+    """
+    hot = JaxHotPathChecker().check_file(_sf(src, "karpenter_tpu/ops/x.py"))
+    cold = JaxHotPathChecker().check_file(_sf(src, "karpenter_tpu/sim/x.py"))
+    assert _rules(hot) == ["JH001"]
+    assert _rules(cold) == []
+
+
+def test_jh002_block_until_ready_flagged_everywhere():
+    src = """
+        def wait(x):
+            x.block_until_ready()
+    """
+    out = JaxHotPathChecker().check_file(_sf(src, "karpenter_tpu/sim/x.py"))
+    assert _rules(out) == ["JH002"]
+
+
+def test_jh003_python_branch_on_traced_param():
+    src = """
+        import jax
+
+        @jax.jit
+        def kern(x, n):
+            if x > 0:
+                return x
+            return n
+    """
+    out = JaxHotPathChecker().check_file(_sf(src, "karpenter_tpu/ops/x.py"))
+    assert "JH003" in _rules(out)
+
+
+def test_jh003_static_params_are_branchable():
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def kern(x, n):
+            if n > 4:
+                return x * 2
+            return x
+    """
+    out = JaxHotPathChecker().check_file(_sf(src, "karpenter_tpu/ops/x.py"))
+    assert "JH003" not in _rules(out)
+
+
+def test_jh004_dynamic_static_spec():
+    src = """
+        import jax
+        from functools import partial
+
+        SPEC = (0, 1)
+
+        @partial(jax.jit, static_argnums=SPEC)
+        def kern(a, b, c):
+            return a + c
+    """
+    out = JaxHotPathChecker().check_file(_sf(src, "karpenter_tpu/ops/x.py"))
+    assert "JH004" in _rules(out)
+
+
+def test_jh005_missing_donation_and_the_donated_negative():
+    bad = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit)
+        def kern(prices, init_used):
+            return init_used + prices
+    """
+    good = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnames=("init_used",))
+        def kern(prices, init_used):
+            return init_used + prices
+    """
+    assert _rules(JaxHotPathChecker().check_file(
+        _sf(bad, "karpenter_tpu/ops/x.py"))) == ["JH005"]
+    assert _rules(JaxHotPathChecker().check_file(
+        _sf(good, "karpenter_tpu/ops/x.py"))) == []
+
+
+def test_jh006_host_conversion_of_traced_value():
+    src = """
+        import jax
+
+        @jax.jit
+        def kern(x):
+            return float(x) * 2
+    """
+    out = JaxHotPathChecker().check_file(_sf(src, "karpenter_tpu/ops/x.py"))
+    assert "JH006" in _rules(out)
+
+
+# ---------------------------------------------------------------------------
+# determinism fixtures — DT rules are repo-level (sim reachability)
+# ---------------------------------------------------------------------------
+
+def _dt(findings):
+    return sorted(f.rule for f in findings if f.rule.startswith("DT"))
+
+
+def _run_dt(*sources):
+    return DeterminismChecker().check_repo(list(sources), REPO)
+
+
+def test_dt001_wall_clock_in_sim_reachable_module():
+    sim = _sf("from karpenter_tpu.state import cluster\n",
+              "karpenter_tpu/sim/world.py")
+    leaf = _sf("""
+        import time
+
+        def stamp():
+            return time.time()
+    """, "karpenter_tpu/state/cluster.py")
+    assert _dt(_run_dt(sim, leaf)) == ["DT001"]
+
+
+def test_dt001_unreachable_module_not_flagged():
+    leaf = _sf("""
+        import time
+
+        def stamp():
+            return time.time()
+    """, "karpenter_tpu/tools_only/x.py")
+    assert _dt(_run_dt(leaf)) == []
+
+
+def test_dt001_allowlisted_shim_not_flagged():
+    sim = _sf("from karpenter_tpu.utils import tracing\n",
+              "karpenter_tpu/sim/world.py")
+    shim = _sf("""
+        import time
+
+        def now():
+            return time.time()
+    """, "karpenter_tpu/utils/tracing.py")
+    assert _dt(_run_dt(sim, shim)) == []
+
+
+def test_dt002_unseeded_rng_flagged_seeded_stream_not():
+    sim = _sf("from karpenter_tpu.forecast import model\n",
+              "karpenter_tpu/sim/world.py")
+    leaf = _sf("""
+        import random
+        import numpy as np
+
+        def noisy():
+            rng = np.random.default_rng([7, 1])
+            return rng.normal() + np.random.rand() + random.random()
+    """, "karpenter_tpu/forecast/model.py")
+    out = _run_dt(sim, leaf)
+    assert _dt(out) == ["DT002", "DT002"]
+    details = {f.detail for f in out}
+    assert details == {"np.random.rand", "random.random"}
+
+
+def test_dt003_set_iteration_flagged_dict_and_sorted_not():
+    sim = _sf("from karpenter_tpu.cloud import thing\n",
+              "karpenter_tpu/sim/world.py")
+    leaf = _sf("""
+        def walk(d):
+            pools = set(d) | {"extra"}
+            for p in pools:
+                print(p)
+            for p in sorted(pools):
+                print(p)
+            for k in d:
+                print(k)
+            return [x for x in {1, 2}]
+    """, "karpenter_tpu/cloud/thing.py")
+    out = [f for f in _run_dt(sim, leaf) if f.rule == "DT003"]
+    assert len(out) == 2          # `for p in pools` + the set-comp source
+    assert {f.line for f in out} == {4, 10}
+
+
+def test_dt003_suppression_comment_respected():
+    sim = _sf("from karpenter_tpu.cloud import thing\n",
+              "karpenter_tpu/sim/world.py")
+    leaf = _sf("""
+        def walk(s):
+            # graftlint: disable=DT003
+            caps = {c for c in s if c}
+            return caps
+    """, "karpenter_tpu/cloud/thing.py")
+    findings = _run_dt(sim, leaf)
+    assert all(is_suppressed(leaf, f) for f in findings
+               if f.rule == "DT003" and findings)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline fixtures
+# ---------------------------------------------------------------------------
+
+def _lk(src):
+    return LockDisciplineChecker().check_file(
+        _sf(src, "karpenter_tpu/cloud/thing.py"))
+
+
+def test_lk001_write_outside_lock():
+    out = _lk("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}      # guarded-by: _lock
+
+            def put(self, k, v):
+                self._data[k] = v
+
+            def put_safe(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+    """)
+    assert _rules(out) == ["LK001"]
+    assert out[0].scope == "Box.put"
+
+
+def test_lk001_mutating_method_calls_and_del():
+    out = _lk("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []     # guarded-by: _lock
+
+            def grow(self, v):
+                self._items.append(v)
+
+            def shrink(self, i):
+                del self._items[i]
+    """)
+    assert _rules(out) == ["LK001", "LK001"]
+    assert sorted(f.detail for f in out) == ["_items:append", "_items:del"]
+
+
+def test_lk001_holds_marker_exempts_helper():
+    out = _lk("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}      # guarded-by: _lock
+
+            def _evict(self, k):  # graftlint: holds(_lock)
+                self._data.pop(k, None)
+    """)
+    assert _rules(out) == []
+
+
+def test_lk001_caller_guard_is_documentation_only():
+    out = _lk("""
+        class Cluster:
+            def __init__(self):
+                self.nodes = {}      # guarded-by: caller(state_lock)
+
+            def add(self, n):
+                self.nodes[n.name] = n
+    """)
+    assert _rules(out) == []
+
+
+def test_lk002_unknown_lock_name():
+    out = _lk("""
+        class Box:
+            def __init__(self):
+                self._data = {}      # guarded-by: _lokc
+    """)
+    assert _rules(out) == ["LK002"]
+
+
+def test_lk002_lock_inherited_from_same_file_base():
+    out = _lk("""
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class Child(Base):
+            def __init__(self):
+                super().__init__()
+                self._vals = {}      # guarded-by: _lock
+
+            def put(self, k, v):
+                with self._lock:
+                    self._vals[k] = v
+    """)
+    assert _rules(out) == []
+
+
+# ---------------------------------------------------------------------------
+# observability fixtures
+# ---------------------------------------------------------------------------
+
+def _tracing_sf():
+    return _sf("""
+        SPAN_NAMES = frozenset({"provision", "solve.pack"})
+
+        def registered(name):
+            return name
+    """, "karpenter_tpu/utils/tracing.py")
+
+
+def test_ob004_unknown_span_literal():
+    user = _sf("""
+        from karpenter_tpu.utils import tracing
+
+        def go():
+            with tracing.span("provision"):
+                pass
+            with tracing.span("not-a-span"):
+                pass
+    """, "karpenter_tpu/controllers/x.py")
+    out = ObservabilityChecker().check_repo([_tracing_sf(), user], REPO)
+    ob4 = [f for f in out if f.rule == "OB004"]
+    assert [f.detail for f in ob4] == ["not-a-span"]
+
+
+def test_ob005_dynamic_span_requires_registered_wrapper():
+    user = _sf("""
+        from karpenter_tpu.utils import tracing
+
+        def go(method):
+            with tracing.span(f"disruption.{method}"):
+                pass
+            with tracing.span(tracing.registered(f"disruption.{method}")):
+                pass
+    """, "karpenter_tpu/controllers/x.py")
+    out = ObservabilityChecker().check_repo([_tracing_sf(), user], REPO)
+    assert [f.rule for f in out] == ["OB005"]
+
+
+def test_ob001_ob003_metrics_contract(tmp_path):
+    metrics = _sf("""
+        REGISTRY = object()
+        LEGACY_ALIASES = {"old_total": "aliased_total"}
+
+        a = REGISTRY.counter("documented_total", "x", labels=("nodepool",))
+        b = REGISTRY.gauge("undocumented_things", "x")
+        c = REGISTRY.counter("leaky_total", "x", labels=("pod",))
+    """, "karpenter_tpu/utils/metrics.py")
+    docs_root = tmp_path
+    docs_dir = docs_root / "docs"
+    docs_dir.mkdir()
+    (docs_dir / "metrics.md").write_text(textwrap.dedent("""\
+        | family | type | labels | meaning |
+        |---|---|---|---|
+        | `documented_total` | counter | nodepool | x |
+        | `leaky_total` | counter | pod | x |
+        | `ghost_total` | counter | - | never registered |
+    """))
+    out = ObservabilityChecker().check_repo([metrics], str(docs_root))
+    rules = _rules(out)
+    assert rules == ["OB001", "OB002", "OB003"]
+    assert {f.detail for f in out} == \
+        {"undocumented_things", "ghost_total", "leaky_total:pod"}
+
+
+def test_real_span_names_match_repo_registry():
+    """Every literal span name in the repo is registered — the live check
+    the OB004 rule enforces, asserted directly for a clear failure."""
+    from karpenter_tpu.utils import tracing
+    sources = iter_sources(REPO)
+    out = ObservabilityChecker().check_repo(sources, REPO)
+    assert [f for f in out if f.rule in ("OB004", "OB005")] == []
+    assert tracing.registered("provision") == "provision"
+    with pytest.raises(ValueError):
+        tracing.registered("definitely-not-a-span")
+
+
+# ---------------------------------------------------------------------------
+# lock-order recorder
+# ---------------------------------------------------------------------------
+
+def _fresh_recorder_locks(names):
+    rec = LockOrderRecorder()
+    rec.enabled = True
+    return rec, {n: _RecordingLock(threading.Lock(), n, rec) for n in names}
+
+
+def test_lock_order_clean_nesting_no_inversions():
+    rec, L = _fresh_recorder_locks(["a", "b"])
+    for _ in range(3):
+        with L["a"]:
+            with L["b"]:
+                pass
+    assert rec.inversions() == []
+    assert ("a", "b") in rec.edges()
+
+
+def test_lock_order_inversion_detected():
+    rec, L = _fresh_recorder_locks(["a", "b"])
+    with L["a"]:
+        with L["b"]:
+            pass
+    with L["b"]:
+        with L["a"]:
+            pass
+    bad = rec.inversions()
+    assert len(bad) == 1
+    assert "'a'" in bad[0] and "'b'" in bad[0]
+
+
+def test_lock_order_cycle_across_threads():
+    rec, L = _fresh_recorder_locks(["a", "b", "c"])
+
+    def chain(x, y):
+        with L[x]:
+            with L[y]:
+                pass
+
+    # a→b and b→c on this thread; c→a on another: 3-cycle, no 2-cycle
+    chain("a", "b")
+    chain("b", "c")
+    t = threading.Thread(target=chain, args=("c", "a"))
+    t.start()
+    t.join()
+    bad = rec.inversions()
+    assert bad and any("cycle" in m for m in bad)
+
+
+def test_named_lock_plain_when_recorder_disabled():
+    from karpenter_tpu.analysis.lockorder import RECORDER
+    prev = RECORDER.enabled
+    RECORDER.enabled = False
+    try:
+        lock = named_lock("test.plain")
+    finally:
+        RECORDER.enabled = prev
+    assert not isinstance(lock, _RecordingLock)
+    with lock:
+        pass
+
+
+def test_named_lock_records_when_session_recorder_enabled():
+    """conftest enables the global RECORDER for the session, so component
+    construction inside tests yields recording proxies (unless the
+    KARPENTER_TPU_LOCK_ORDER=0 kill switch is set)."""
+    from karpenter_tpu.analysis.lockorder import RECORDER
+    if not RECORDER.enabled:
+        pytest.skip("recorder disabled via KARPENTER_TPU_LOCK_ORDER=0")
+    lock = named_lock("test.recorded")
+    assert isinstance(lock, _RecordingLock)
+    with lock:
+        pass
+
+
+def test_recording_rlock_reentrancy():
+    rec = LockOrderRecorder()
+    rec.enabled = True
+    lock = _RecordingLock(threading.RLock(), "r", rec)
+    with lock:
+        with lock:
+            pass
+    assert rec.inversions() == []   # self-edges never count
+
+
+# ---------------------------------------------------------------------------
+# finding identity / suppression / baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_finding_key_is_line_free():
+    a = Finding("DT003", "p.py", 10, "f", "pools", "m")
+    b = Finding("DT003", "p.py", 99, "f", "pools", "m")
+    assert a.key == b.key
+
+
+def test_partition_reports_stale_entries():
+    f = Finding("DT003", "p.py", 1, "f", "pools", "m")
+    new, old, stale = partition([f], {f.key, "JH001|gone.py|f|x"})
+    assert new == [] and old == [f]
+    assert stale == {"JH001|gone.py|f|x"}
+
+
+def test_render_includes_fix_hint():
+    f = Finding("DT003", "p.py", 3, "f", "pools", "set iteration")
+    assert "fix:" in f.render(fix_hints=True)
+    assert "fix:" not in f.render(fix_hints=False)
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke
+# ---------------------------------------------------------------------------
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"), *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+@pytest.mark.slow
+def test_cli_clean_against_baseline():
+    p = _cli()
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "clean" in p.stdout
+
+
+@pytest.mark.slow
+def test_cli_json_and_list_rules():
+    p = _cli("--list-rules")
+    assert p.returncode == 0
+    for rid in ("JH001", "DT003", "LK001", "OB004"):
+        assert rid in p.stdout
+    q = _cli("--json")
+    doc = json.loads(q.stdout)
+    assert doc["new"] == []
+    assert all(k.startswith("JH005|") for k in
+               (f"{f['rule']}|" for f in doc["grandfathered"]))
+
+
+def test_default_checkers_cover_all_families():
+    fams = {c.family for c in default_checkers()}
+    assert fams == {"jax-hotpath", "determinism", "lock-discipline",
+                    "observability"}

@@ -107,6 +107,24 @@ def test_native_matches_jax_on_inf_priced_only_fit():
     assert [n.option.instance_type for n in a.nodes] == ["a.small"]
 
 
+def test_native_matches_jax_on_score_overflow():
+    # price × ceil(tail/m) can overflow float32 even with finite prices:
+    # here 3e38 × 2 → +inf.  Unguarded, the JAX kernel's argmin over
+    # all-inf scores returned index 0 — the cheap INCOMPATIBLE type —
+    # while `can_new` still said yes, so pods landed on a node that can't
+    # hold them.  Both backends clamp at the shared SCORE_CAP instead,
+    # keeping the viable option selected and the backends in agreement.
+    catalog = [make_type("tiny", 1, 1, 0.05),
+               make_type("big", 64, 256, 3e38)]
+    pods = [cpu_pod(cpu_m=33000), cpu_pod(cpu_m=33000)]
+    prob = tensorize(pods, catalog, [NodePool()])
+    a = native.solve_ffd_native(prob)
+    b = solve_ffd(prob, backend="jax")
+    assert_same_result(a, b)
+    assert not b.unschedulable
+    assert [n.option.instance_type for n in b.nodes] == ["big", "big"]
+
+
 def test_build_is_idempotent():
     assert native.build()
     assert native.build()
