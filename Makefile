@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast benchmark-interruption trace-demo sim-demo deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip benchmark-interruption trace-demo sim-demo deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -30,6 +30,9 @@ bench-sim: ## 24h diurnal replay speedup (sim-diurnal-24h, one JSON line)
 
 bench-forecast: ## Predictive-headroom A/B: diurnal-forecast on vs off (one JSON line)
 	python bench.py --forecast
+
+bench-drip: ## Steady-state drip: 50k-pod incremental-arena delta ticks vs full rebuild (one JSON line)
+	python bench.py --drip
 
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py

@@ -370,6 +370,92 @@ def run_consolidation_replay(n_pods=2590, scale_down=0.72, n_types=200,
     return out
 
 
+def run_steady_state_drip(n_pods=50_000, n_nodes=2000, n_classes=50,
+                          ticks=100):
+    """`make bench-drip`: the incremental-arena value proof.  A warm
+    50k-pod / 2k-node cluster absorbs one {reclaim + bind} pair per tick
+    — the steady-state shape where the old path re-ran the full
+    O(nodes × classes) tensorize_nodes for a two-row change.  Per tick we
+    time the DELTA path (the two cluster mutations streaming into the
+    attached ClusterArena, then a warm `gather`) against the from-scratch
+    `tensorize_nodes` over the same state, asserting bit-identity on a
+    sample of ticks.  Headline: delta_tick_p50 (acceptance <10ms on CPU)
+    and the speedup over full_rebuild_p50 (acceptance >=5x)."""
+    from karpenter_tpu.api.objects import Node, Pod
+    from karpenter_tpu.api.resources import CPU, MEMORY, PODS, ResourceList
+    from karpenter_tpu.state import Cluster
+
+    rng = np.random.default_rng(7)
+    specs = [ResourceList({CPU: int(rng.integers(100, 2000)),
+                           MEMORY: int(rng.integers(128, 4096)) * 2**20})
+             for _ in range(n_classes)]
+    reps = [Pod(requests=ResourceList(s)) for s in specs]
+    cluster = Cluster()
+    per_node = -(-n_pods // n_nodes)  # ceil
+    for i in range(n_nodes):
+        cluster.add_node(Node(
+            name=f"drip-{i:05d}",
+            allocatable=ResourceList({CPU: 64_000, MEMORY: 256 * 2**30,
+                                      PODS: per_node + 8})))
+    node_names = [f"drip-{i:05d}" for i in range(n_nodes)]
+    # seed cold (no arena attached): 50k add+bind pairs stream nowhere
+    t0 = time.perf_counter()
+    for i in range(n_pods):
+        pod = Pod(requests=ResourceList(specs[i % n_classes]))
+        cluster.add_pod(pod)
+        cluster.bind_pod(pod, node_names[i % n_nodes])
+    seed_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    arena = cluster.attach_arena()
+    attach_ms = (time.perf_counter() - t0) * 1000
+    arena.gather(reps)  # intern the class columns before the timed loop
+    log(f"[steady-state-drip] seeded pods={n_pods} nodes={n_nodes} "
+        f"classes={n_classes} in {seed_s:.1f}s; arena attach "
+        f"{attach_ms:.1f}ms")
+
+    delta_ms, rebuild_ms = [], []
+    bound = [p for p in cluster.pods.values() if p.node_name]
+    for tick in range(ticks):
+        victim = bound[tick % len(bound)]
+        fresh = Pod(requests=ResourceList(specs[tick % n_classes]))
+        target = victim.node_name
+        # delta tick: the two mutations (streamed into the arena by the
+        # cluster hooks) + the warm gather the next solve would consume
+        t0 = time.perf_counter()
+        cluster.delete_pod(victim)            # reclaim
+        cluster.add_pod(fresh)                # replacement arrives
+        cluster.bind_pod(fresh, target)       # ... and binds
+        warm = arena.gather(reps)
+        delta_ms.append((time.perf_counter() - t0) * 1000)
+        assert warm is not None, "drip gather fell back to the cold path"
+        bound[tick % len(bound)] = fresh
+        # full-rebuild comparator: from-scratch tensorize of the SAME state
+        t0 = time.perf_counter()
+        scratch = cluster.tensorize_nodes(reps)
+        rebuild_ms.append((time.perf_counter() - t0) * 1000)
+        if tick % 25 == 0:  # bit-identity audit on a sample of ticks
+            for w, s in zip(warm[1:], scratch[1:]):
+                assert np.array_equal(w, s), "drip parity violation"
+    delta_p50 = float(np.median(delta_ms))
+    rebuild_p50 = float(np.median(rebuild_ms))
+    speedup = rebuild_p50 / delta_p50 if delta_p50 > 0 else float("inf")
+    log(f"[steady-state-drip] ticks={ticks} delta_p50={delta_p50:.2f}ms "
+        f"p95={float(np.percentile(delta_ms, 95)):.2f}ms "
+        f"full_rebuild_p50={rebuild_p50:.1f}ms speedup={speedup:.1f}x "
+        f"epoch={arena.epoch} compactions={arena.compactions}")
+    return {
+        "delta_tick_p50": round(delta_p50, 3),
+        "delta_tick_p95": round(float(np.percentile(delta_ms, 95)), 3),
+        "full_rebuild_p50": round(rebuild_p50, 2),
+        "speedup": round(speedup, 2),
+        "drip_ticks": ticks,
+        "drip_pods": n_pods,
+        "drip_nodes": n_nodes,
+        "drip_classes": n_classes,
+        "arena_attach_ms": round(attach_ms, 2),
+    }
+
+
 def run_interruption_benchmark(sizes=(100, 1000, 5000, 15000)):
     """The reference's `make benchmark`
     (/root/reference/pkg/controllers/interruption/interruption_benchmark_test.go:62-79)
@@ -458,7 +544,8 @@ def _run_child(env, timeout=3000):
     the caller then falls back rather than crashing without a JSON line."""
     bench = os.path.abspath(__file__)
     args = [sys.executable, bench, "--run"]
-    for flag in ("--smoke", "--consolidation", "--sim", "--forecast"):
+    for flag in ("--smoke", "--consolidation", "--sim", "--forecast",
+                 "--drip"):
         if flag in sys.argv[1:]:
             args.append(flag)
     try:
@@ -499,11 +586,26 @@ def main():
     sys.exit(1 if rc is None else rc)
 
 
-def run_all(smoke=False, consolidation=False, sim=False, forecast=False):
+def run_all(smoke=False, consolidation=False, sim=False, forecast=False,
+            drip=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
     rng = np.random.default_rng(42)
+
+    if drip:
+        # `make bench-drip`: 50k-pod steady-state churn through the
+        # incremental arena (pure host-side numpy — jax is imported only
+        # for the backend-provenance fields every tail must carry)
+        d = run_steady_state_drip()
+        tail = {"metric": "50k-pod steady-state drip delta-tick p50 latency",
+                "value": d["delta_tick_p50"],
+                "unit": "ms",
+                "vs_baseline": round(10.0 / d["delta_tick_p50"], 3)
+                if d["delta_tick_p50"] else None}
+        tail.update(d)
+        _emit(tail, platform)
+        return
 
     if forecast:
         # `make bench-forecast`: the predictive-headroom value proof — the
@@ -642,6 +744,7 @@ if __name__ == "__main__":
         run_all(smoke="--smoke" in sys.argv[1:],
                 consolidation="--consolidation" in sys.argv[1:],
                 sim="--sim" in sys.argv[1:],
-                forecast="--forecast" in sys.argv[1:])
+                forecast="--forecast" in sys.argv[1:],
+                drip="--drip" in sys.argv[1:])
     else:
         main()

@@ -1,0 +1,134 @@
+"""arena-discipline checker family (AR*).
+
+The persistent cluster arena (ops/arena.py) keeps the solver's input
+tensors alive across ticks; its bit-identity contract with the
+from-scratch `tensorize_nodes` path holds only if every slab mutation
+flows through the typed delta API, under the state lock.  Two lexical
+rules keep that closed:
+
+  * AR001 — a write to an arena slab tensor (``slab_alloc``,
+    ``slab_used``, ``slab_compat``, ``slab_live``) anywhere OUTSIDE
+    `karpenter_tpu/ops/arena.py`.  Consumers get copies from `gather()`;
+    nothing else may reach into the slab.
+  * AR002 — a function inside `ops/arena.py` that writes a slab tensor
+    without a `# guarded-by:` / `# graftlint: holds(...)` lock annotation
+    on its `def` line (or the line above).  Every delta-API entry point
+    documents the externally-held state lock the same way the Cluster's
+    maps do (see analysis/locks.py for the convention).
+
+Writes are: assignment / augmented assignment whose target chain touches
+a slab attribute (``self.slab_used[slot] = ...``, ``arena.slab_live[i] =
+False``), `del` on such a chain, and in-place ndarray mutator calls
+(``.fill(...)``, ``.sort()``, ``.resize(...)``, ``.put(...)``) on one.
+Reads are out of scope — `gather()`'s fancy indexing copies, so reads
+can't corrupt the slab.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from .core import Checker, Finding, SourceFile, rule
+
+rule("AR001", "arena-discipline",
+     "arena slab tensor mutated outside the delta API module",
+     "route the mutation through a ClusterArena delta method "
+     "(apply_*/touch_node/compact/rebuild) in ops/arena.py — consumers "
+     "must treat gather() output as read-only copies")
+rule("AR002", "arena-discipline",
+     "slab-mutating arena method lacks a lock annotation",
+     "annotate the def line with `# guarded-by: caller(state_lock)` (or "
+     "`# graftlint: holds(<lock>)`) — every slab write happens under the "
+     "operator's state lock")
+
+ARENA_MODULE = "karpenter_tpu/ops/arena.py"
+SLAB_ATTRS = frozenset({"slab_alloc", "slab_used", "slab_compat",
+                        "slab_live"})
+_NDARRAY_MUTATORS = frozenset({"fill", "sort", "resize", "put"})
+_ANNOT_RE = re.compile(
+    r"#\s*(guarded-by:|graftlint:\s*holds\()")
+
+
+def _chain_slab_attr(node: ast.AST) -> Optional[str]:
+    """First slab attribute named anywhere in an Attribute/Subscript
+    chain (``self.slab_used[slot]`` → 'slab_used')."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute) and node.attr in SLAB_ATTRS:
+            return node.attr
+        node = node.value
+    return None
+
+
+def _slab_writes(tree: ast.AST) -> List[Tuple[ast.AST, str, str]]:
+    """(node, slab-attr, kind) for every slab write site under `tree`."""
+    writes: List[Tuple[ast.AST, str, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                attr = _chain_slab_attr(tgt)
+                if attr is not None:
+                    writes.append((node, attr, "assign"))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                attr = _chain_slab_attr(tgt)
+                if attr is not None:
+                    writes.append((node, attr, "del"))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _NDARRAY_MUTATORS:
+            attr = _chain_slab_attr(node.func.value)
+            if attr is not None:
+                writes.append((node, attr, node.func.attr))
+    return writes
+
+
+def _def_annotated(sf: SourceFile, fn: ast.FunctionDef) -> bool:
+    for lineno in (fn.lineno, fn.lineno - 1):
+        if _ANNOT_RE.search(sf.line_text(lineno)):
+            return True
+    return False
+
+
+class ArenaDisciplineChecker(Checker):
+    family = "arena-discipline"
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.rel == ARENA_MODULE:
+            return self._check_arena_module(sf)
+        findings: List[Finding] = []
+        for node, attr, kind in _slab_writes(sf.tree):
+            findings.append(Finding(
+                "AR001", sf.rel, node.lineno, sf.scope_of(node),
+                f"{attr}:{kind}",
+                f"mutation of arena slab tensor {attr!r} ({kind}) outside "
+                f"the delta API ({ARENA_MODULE})"))
+        return findings
+
+    def _check_arena_module(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        parents = sf.parents()
+        flagged = set()
+        for node, attr, kind in _slab_writes(sf.tree):
+            # walk up to the enclosing def; __init__ (slab creation) and
+            # module level are exempt, everything else needs the annotation
+            cur: Optional[ast.AST] = node
+            fn: Optional[ast.FunctionDef] = None
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = cur
+                    break
+                cur = parents.get(cur)
+            if fn is None or fn.name == "__init__" or fn in flagged:
+                continue
+            if not _def_annotated(sf, fn):
+                flagged.add(fn)
+                findings.append(Finding(
+                    "AR002", sf.rel, fn.lineno, sf.scope_of(node),
+                    fn.name,
+                    f"method {fn.name!r} mutates slab tensor {attr!r} "
+                    f"without a lock annotation on its def line"))
+        return findings

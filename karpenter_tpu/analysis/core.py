@@ -162,12 +162,14 @@ def iter_sources(root: str,
 
 
 def default_checkers() -> List[Checker]:
+    from .arena import ArenaDisciplineChecker
     from .determinism import DeterminismChecker
     from .jaxhot import JaxHotPathChecker
     from .locks import LockDisciplineChecker
     from .observability import ObservabilityChecker
     return [JaxHotPathChecker(), DeterminismChecker(),
-            LockDisciplineChecker(), ObservabilityChecker()]
+            LockDisciplineChecker(), ObservabilityChecker(),
+            ArenaDisciplineChecker()]
 
 
 def run_analysis(root: str,

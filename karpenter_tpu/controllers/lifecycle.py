@@ -143,6 +143,7 @@ class LifecycleController:
             # next one (taint clearance and readiness are separate
             # observations in the reference too)
             node.taints = [t for t in node.taints if t.key not in clearable]
+            self.cluster.touch_node(node)
             return
         # any remaining node.kubernetes.io/* taint is a live condition
         # (unreachable, disk-pressure…) owned by the node controller — wait,
@@ -158,6 +159,7 @@ class LifecycleController:
                 max(0.0, claim.initialized_at - claim.registered_at))
         metrics.nodeclaims_initialized().inc({"nodepool": claim.nodepool})
         node.labels[wk.NODE_INITIALIZED] = "true"
+        self.cluster.touch_node(node)
         # pods that bound while the node was still coming up reach
         # "running on a ready node" now (karpenter_pods_startup_time_seconds)
         for p_ in node.pods:

@@ -235,9 +235,21 @@ class Provisioner:
                              options=problem.num_options)
             with tracing.span("solve.pack", level=level) as psp:
                 if schedule_on_existing and node_view:
-                    node_list, alloc, used, compat = self.cluster.tensorize_nodes(
-                        problem.class_reps, problem.axes, scales=problem.scales,
-                        nodes=node_view)
+                    # warm arena gather only for the LIVE node set (nodes is
+                    # None ⇒ node_view IS cluster.nodes.values(), under the
+                    # state lock); snapshot solves keep the full path — the
+                    # slab mirrors live state, not the caller's snapshot
+                    gathered = None
+                    if (nodes is None
+                            and getattr(self.cluster, "arena", None) is not None):
+                        gathered = self.cluster.arena.gather(
+                            problem.class_reps, problem.axes,
+                            scales=problem.scales)
+                    if gathered is None:
+                        gathered = self.cluster.tensorize_nodes(
+                            problem.class_reps, problem.axes,
+                            scales=problem.scales, nodes=node_view)
+                    node_list, alloc, used, compat = gathered
                     solve = self._pick_solver(problem, n_existing=len(node_list))
                     psp.annotate(
                         solver="ffd" if solve is solve_ffd else "classpack",

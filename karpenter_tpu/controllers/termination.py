@@ -65,6 +65,7 @@ class TerminationController:
         # 'disrupting') — duplicate keys are invalid node state
         node.taints = [t for t in node.taints
                        if t.key != TERMINATION_TAINT.key] + [TERMINATION_TAINT]
+        self.cluster.touch_node(node)
         self._queue.setdefault(node.name, reason)
         self._requested_at.setdefault(node.name, self.clock())
 

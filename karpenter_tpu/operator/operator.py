@@ -126,6 +126,10 @@ class Operator:
             clock=clock)
 
         self.cluster = Cluster(clock=clock)
+        if self.options.gate("IncrementalArena"):
+            # attach BEFORE hydration so restart recovery streams through
+            # the delta API and the first tick gathers warm
+            self.cluster.attach_arena()
         # one state lock shared by the tick loop (ControllerManager), the
         # /v1 surface, and the metrics collector — scrapes and solves must
         # never iterate cluster state mid-mutation (advisor r4)

@@ -64,9 +64,15 @@ class Options:
     # Forecast: demand forecasting + proactive headroom provisioning
     # (karpenter_tpu/forecast/) — off by default; enable with --forecast
     # or --feature-gates Forecast=true.  Knobs below (docs/forecast.md).
+    # IncrementalArena: persistent delta-maintained cluster tensorization
+    # (ops/arena.py) feeding provisioning + consolidation warm arrays — on
+    # by default; --feature-gates IncrementalArena=false is the
+    # full-rebuild escape hatch (every consumer falls back to
+    # tensorize_nodes).  --incremental-arena is the explicit-on shorthand.
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
-                                 "LPRefinery": False, "Forecast": False})
+                                 "LPRefinery": False, "Forecast": False,
+                                 "IncrementalArena": True})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -144,6 +150,13 @@ class Options:
                        choices=("ewma", "holtwinters"),
                        default=env.get("forecast_model", "holtwinters"),
                        help="demand forecaster")
+        p.add_argument("--incremental-arena", action="store_true",
+                       default=False,
+                       help="maintain the cluster tensorization "
+                            "incrementally via typed deltas (shorthand for "
+                            "--feature-gates IncrementalArena=true; on by "
+                            "default — disable with --feature-gates "
+                            "IncrementalArena=false)")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -177,6 +190,8 @@ class Options:
             opts.feature_gates["LPRefinery"] = True
         if ns.forecast:
             opts.feature_gates["Forecast"] = True
+        if ns.incremental_arena:
+            opts.feature_gates["IncrementalArena"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts

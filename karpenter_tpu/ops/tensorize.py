@@ -657,6 +657,19 @@ class SimulationArena:
         self._pods = pods
         self._delete: Optional[_ArenaSide] = None
         self._replace: Optional[_ArenaSide] = None
+        # staleness guard (the lazy-face hazard): faces tensorized from an
+        # earlier cluster state must never serve a sweep after ANY cluster
+        # mutation — a bind between sweeps changes used rows, a taint edit
+        # changes compat.  The cluster's mutation_epoch is bumped by every
+        # mutator, so comparing it is an O(1) validity check.
+        self._built_epoch = getattr(cluster, "mutation_epoch", None)
+
+    def _check_stale(self):
+        epoch = getattr(self._cluster, "mutation_epoch", None)
+        if epoch != self._built_epoch:
+            self._delete = None
+            self._replace = None
+            self._built_epoch = epoch
 
     # ---- face construction ------------------------------------------------
     def _build_side(self, catalog) -> _ArenaSide:
@@ -677,10 +690,20 @@ class SimulationArena:
                             node_classes=self._node_classes)
         # ALL live nodes as columns — each probe masks its own subset, the
         # rest act as survivors exactly as in the sequential per-probe
-        # tensorize_nodes(exclude=subset)
-        node_list, alloc, used, compat = self._cluster.tensorize_nodes(
-            problem.class_reps, problem.axes, exclude=(),
-            scales=problem.scales)
+        # tensorize_nodes(exclude=subset).  A warm ClusterArena serves the
+        # same arrays bit-identically from its slab; gather() returning
+        # None (extra axes, untracked node) falls back to the full path.
+        cluster_arena = getattr(self._cluster, "arena", None)
+        gathered = None
+        if cluster_arena is not None:
+            gathered = cluster_arena.gather(
+                problem.class_reps, problem.axes, exclude=(),
+                scales=problem.scales)
+        if gathered is None:
+            gathered = self._cluster.tensorize_nodes(
+                problem.class_reps, problem.axes, exclude=(),
+                scales=problem.scales)
+        node_list, alloc, used, compat = gathered
         col_of = {n.name: i for i, n in enumerate(node_list)}
         C = problem.num_classes
         cid = np.zeros(len(lowered), np.int64)
@@ -697,12 +720,14 @@ class SimulationArena:
 
     @property
     def delete_side(self) -> _ArenaSide:
+        self._check_stale()
         if self._delete is None:
             self._delete = self._build_side([])
         return self._delete
 
     @property
     def replace_side(self) -> _ArenaSide:
+        self._check_stale()
         if self._replace is None:
             self._replace = self._build_side(self._catalog)
         return self._replace

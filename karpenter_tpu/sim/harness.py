@@ -99,10 +99,14 @@ class SimHarness:
 
     def __init__(self, scenario: Scenario, seed: int = 0,
                  duration_s: Optional[float] = None,
-                 forecast: Optional[bool] = None):
+                 forecast: Optional[bool] = None,
+                 incremental_arena: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
-        twice — knobs still come from the scenario's forecast block."""
+        twice — knobs still come from the scenario's forecast block.
+        `incremental_arena` likewise overrides the IncrementalArena gate
+        (default on): False replays the exact pre-arena full-rebuild code
+        paths, the golden byte-identity escape hatch."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -123,6 +127,8 @@ class SimHarness:
         opts = Options(interruption_queue="sim-interruptions",
                        batch_idle_duration=scenario.batch_idle_s,
                        batch_max_duration=scenario.batch_max_s)
+        if incremental_arena is not None:
+            opts.feature_gates["IncrementalArena"] = bool(incremental_arena)
         fc = scenario.forecast
         fc_on = forecast if forecast is not None \
             else (fc is not None and fc.enabled)
@@ -218,6 +224,7 @@ class SimHarness:
             node = original(claim, allocatable, capacity,
                             initialized=False, rehydrate=rehydrate)
             node.taints = list(node.taints) + [Taint(BOOT_TAINT)]
+            harness.cluster.touch_node(node)
             harness._booting[node.name] = []
             harness.heap.push(harness.clock.now() + harness._ready_latency,
                               ev.NodeReady(node=node.name))
@@ -292,6 +299,7 @@ class SimHarness:
             if node is not None:
                 node.taints = [t for t in node.taints
                                if t.key != BOOT_TAINT]
+                self.cluster.touch_node(node)
             now = self.clock.now()
             for uid in self._booting.pop(event.node, []):
                 if uid not in self._bind_t and uid in self._arrive_t:

@@ -48,6 +48,23 @@ class Cluster:
         # pod_added/pod_removed/pod_bound callbacks under the caller's
         # state lock; None unless the Forecast gate wires one
         self.observer = None
+        # optional persistent delta arena (ops/arena.py ClusterArena): every
+        # mutator below forwards its delta so consumers can gather warm
+        # tensors instead of re-running tensorize_nodes; None unless the
+        # IncrementalArena gate attaches one
+        self.arena = None                       # guarded-by: caller(state_lock)
+        # monotone mutation counter, bumped by EVERY mutator (arena attached
+        # or not): cached tensorizations (SimulationArena faces, the
+        # disruption fingerprint) compare it to detect staleness lazily
+        self.mutation_epoch = 0                 # guarded-by: caller(state_lock)
+
+    def attach_arena(self, **kwargs):
+        """Create and attach a ClusterArena seeded from current state; every
+        subsequent mutation streams into it as a typed delta."""
+        from ..ops.arena import ClusterArena
+        self.arena = ClusterArena(self, **kwargs)
+        self.arena.rebuild()
+        return self.arena
 
     # ---- pods ----
     def add_pod(self, pod: Pod) -> Pod:
@@ -61,6 +78,9 @@ class Cluster:
         pod_is_soft(pod)
         if self.observer is not None:
             self.observer.pod_added(pod)
+        self.mutation_epoch += 1
+        if self.arena is not None:
+            self.arena.apply_pod_add(pod)
         return pod
 
     def add_pods(self, pods: Sequence[Pod]) -> List[Pod]:
@@ -68,14 +88,20 @@ class Cluster:
 
     def delete_pod(self, pod: Pod):
         existed = self.pods.pop(pod.uid, None) is not None
+        bound_to = ""
         if pod.node_name and pod.node_name in self.nodes:
             node = self.nodes[pod.node_name]
             node.pods = [p for p in node.pods if p.uid != pod.uid]
+            bound_to = node.name
         if existed and self.observer is not None:
             self.observer.pod_removed(pod)
+        self.mutation_epoch += 1
+        if self.arena is not None:
+            self.arena.apply_pod_remove(pod, bound_to)
 
     def bind_pod(self, pod: Pod, node_name: str):
         rebind = bool(pod.node_name)
+        old_node = pod.node_name if rebind else ""
         if pod.node_name and pod.node_name in self.nodes:
             old = self.nodes[pod.node_name]
             old.pods = [p for p in old.pods if p.uid != pod.uid]
@@ -101,12 +127,20 @@ class Cluster:
                     max(0.0, self.clock() - pod.created_at))
         if not rebind and self.observer is not None:
             self.observer.pod_bound(pod)
+        self.mutation_epoch += 1
+        if self.arena is not None:
+            self.arena.apply_pod_bind(pod, node_name, old_node)
 
     def unbind_pod(self, pod: Pod):
+        was_on = ""
         if pod.node_name and pod.node_name in self.nodes:
             node = self.nodes[pod.node_name]
             node.pods = [p for p in node.pods if p.uid != pod.uid]
+            was_on = node.name
         pod.node_name = ""
+        self.mutation_epoch += 1
+        if self.arena is not None and was_on:
+            self.arena.apply_pod_unbind(was_on)
 
     def pending_pods(self) -> List[Pod]:
         return [p for p in self.pods.values() if not p.node_name]
@@ -120,6 +154,9 @@ class Cluster:
     # ---- nodes / claims ----
     def add_node(self, node: Node) -> Node:
         self.nodes[node.name] = node
+        self.mutation_epoch += 1
+        if self.arena is not None:
+            self.arena.apply_node_add(node)
         return node
 
     def remove_node(self, name: str) -> Optional[Node]:
@@ -135,7 +172,19 @@ class Cluster:
                     if self.observer is not None:
                         self.observer.pod_removed(p)
             node.pods = []
+            self.mutation_epoch += 1
+            if self.arena is not None:
+                self.arena.apply_node_remove(name)
         return node
+
+    def touch_node(self, node: Node):
+        """Callers that edit a node's labels/taints/allocatable IN PLACE
+        (lifecycle initialization, termination + disruption tainting, sim
+        boot-taint stripping) must report it here so the arena re-derives
+        the node's row and cached tensorizations notice the change."""
+        self.mutation_epoch += 1
+        if self.arena is not None:
+            self.arena.touch_node(node)
 
     def register_nodeclaim(self, claim: NodeClaim, allocatable: ResourceList,
                            capacity: Optional[ResourceList] = None,

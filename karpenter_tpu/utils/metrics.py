@@ -433,6 +433,52 @@ def disruption_arena_requests() -> Counter:
         labels=("outcome",))
 
 
+def arena_epoch() -> Gauge:
+    """Monotone delta counter of the persistent cluster arena
+    (ops/arena.py) — one bump per applied delta; consumers key staleness
+    checks on it instead of re-fingerprinting the object graph."""
+    return REGISTRY.gauge(
+        "karpenter_arena_epoch",
+        "Current epoch (applied-delta count) of the cluster arena.")
+
+
+def arena_slots() -> Gauge:
+    """Slab occupancy of the cluster arena: `live` rows vs `tombstone`
+    rows awaiting compaction."""
+    return REGISTRY.gauge(
+        "karpenter_arena_slots",
+        "Cluster-arena slab slots by state.",
+        labels=("state",))
+
+
+def arena_deltas() -> Counter:
+    """Typed deltas applied to the cluster arena (pod_bind, pod_unbind,
+    pod_add, pod_remove, node_add, node_remove, touch, offering, compact,
+    rebuild, invalidate)."""
+    return REGISTRY.counter(
+        "karpenter_arena_deltas_total",
+        "Deltas applied to the cluster arena, by kind.",
+        labels=("kind",))
+
+
+def arena_compactions() -> Counter:
+    """Slab compactions — tombstone count crossed the compaction
+    threshold and live rows were densified."""
+    return REGISTRY.counter(
+        "karpenter_arena_compactions_total",
+        "Cluster-arena slab compactions.")
+
+
+def arena_gather() -> Counter:
+    """Arena gather outcomes: `warm` (slab served the request) vs
+    `fallback` (caller re-tensorized from scratch — extra axes, untracked
+    node, or explicit invalidation)."""
+    return REGISTRY.counter(
+        "karpenter_arena_gather_total",
+        "Cluster-arena gather requests by outcome.",
+        labels=("outcome",))
+
+
 def trace_span_duration() -> Histogram:
     """Duration of every completed tracing span (utils/tracing.py), labeled
     by span name — the histogram the /debug/traces timeline feeds so

@@ -51,6 +51,8 @@ SPAN_NAMES = frozenset({
     "disruption.reconcile", "disruption.candidates", "disruption.execute",
     "disruption.expiration", "disruption.drift", "disruption.consolidation",
     "sweep.arena", "sweep.prefix", "sweep.decode", "sweep.single",
+    # persistent cluster arena (ops/arena.py)
+    "arena.rebuild", "arena.compact",
     # refinery + LP guide
     "refinery.refine", "refinery.lp", "refinery.price",
     # forecast/headroom reconcile

@@ -1,0 +1,405 @@
+"""Incremental-arena property suite: a ClusterArena fed a randomized event
+stream must stay BIT-IDENTICAL in solve inputs to a from-scratch
+`Cluster.tensorize_nodes` of the final state — through bind/unbind churn,
+node add/remove, in-place taint edits, forced compactions, and class-table
+resets — plus the fallback contract (extra axes / untracked rows return
+None), the disruption controller's fingerprint-keyed size-1 arena cache,
+and the lazy-face staleness regression (ISSUE 7 satellites 1 and 6)."""
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import Disruption, NodePool
+from karpenter_tpu.api.resources import DEFAULT_AXES, DEFAULT_SCALES
+from karpenter_tpu.api.taints import Taint, Toleration
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.controllers.disruption import DisruptionController
+from karpenter_tpu.ops.arena import ClusterArena
+from karpenter_tpu.state import Cluster
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+
+
+def env(catalog=None, arena_kwargs=None):
+    clock = FakeClock()
+    cloud = FakeCloud(clock)
+    provider = CloudProvider(cloud, catalog or small_catalog(), clock=clock)
+    cluster = Cluster(clock)
+    cluster.attach_arena(**(arena_kwargs or {}))
+    pools = [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized"))]
+    prov = Provisioner(provider, cluster, pools, clock=clock)
+    ctrl = DisruptionController(provider, cluster, pools, clock=clock,
+                                stabilization_s=0.0)
+    return clock, cloud, provider, cluster, prov, ctrl
+
+
+def provision(cluster, prov, pods):
+    cluster.add_pods(pods)
+    res = prov.provision()
+    assert not res.unschedulable
+    return res
+
+
+def class_reps():
+    """A mixed bag of pod equivalence classes: plain, selector-constrained
+    (hits the compat row math), and tolerating (hits the taint row math)."""
+    return [
+        cpu_pod(cpu_m=500, mem_mib=512),
+        cpu_pod(cpu_m=1500, mem_mib=2048),
+        cpu_pod(cpu_m=250, mem_mib=256,
+                node_selector={wk.INSTANCE_TYPE: "a.large"}),
+        cpu_pod(cpu_m=250, mem_mib=256,
+                tolerations=[Toleration(key="", operator="Exists")]),
+    ]
+
+
+def assert_gather_matches_scratch(cluster, reps, exclude=()):
+    """The bit-identity contract: same node objects in the same order, same
+    values, same dtypes as a from-scratch tensorize_nodes."""
+    gathered = cluster.arena.gather(reps, exclude=exclude)
+    assert gathered is not None, "warm gather unexpectedly fell back"
+    g_nodes, g_alloc, g_used, g_compat = gathered
+    s_nodes, s_alloc, s_used, s_compat = cluster.tensorize_nodes(
+        reps, exclude=exclude)
+    assert len(g_nodes) == len(s_nodes)
+    assert all(a is b for a, b in zip(g_nodes, s_nodes))
+    assert g_alloc.dtype == s_alloc.dtype == np.float32
+    assert g_used.dtype == s_used.dtype == np.float32
+    assert g_compat.dtype == s_compat.dtype == np.bool_
+    np.testing.assert_array_equal(g_alloc, s_alloc)
+    np.testing.assert_array_equal(g_used, s_used)
+    np.testing.assert_array_equal(g_compat, s_compat)
+
+
+# ---------------------------------------------------------------------------
+# randomized event-stream bit-identity (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_randomized_event_stream_bit_identity(seed):
+    """Drive the cluster through a random interleaving of provisions, pod
+    deletions, rebinds, node removals, and in-place taint edits; at every
+    checkpoint the warm gather must equal a from-scratch tensorize."""
+    rng = np.random.default_rng(seed)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    reps = class_reps()
+
+    for step in range(30):
+        op = rng.integers(0, 5)
+        if op == 0:  # provision a fresh pod group (binds, maybe new nodes)
+            k = int(rng.integers(1, 4))
+            pods = [cpu_pod(cpu_m=int(rng.integers(200, 1800)),
+                            mem_mib=int(rng.integers(256, 3000)))
+                    for _ in range(k)]
+            cluster.add_pods(pods)
+            prov.provision()
+        elif op == 1 and cluster.pods:  # delete a random pod
+            victims = sorted(cluster.pods.values(), key=lambda p: p.uid)
+            cluster.delete_pod(victims[int(rng.integers(len(victims)))])
+        elif op == 2 and cluster.pods:  # unbind (back to pending)
+            bound = [p for p in cluster.pods.values() if p.node_name]
+            if bound:
+                cluster.unbind_pod(bound[int(rng.integers(len(bound)))])
+        elif op == 3 and len(cluster.nodes) > 1:  # remove a random node
+            names = sorted(cluster.nodes)
+            cluster.remove_node(names[int(rng.integers(len(names)))])
+        elif op == 4 and cluster.nodes:  # in-place taint edit + touch
+            names = sorted(cluster.nodes)
+            node = cluster.nodes[names[int(rng.integers(len(names)))]]
+            if node.taints:
+                node.taints = []
+            else:
+                node.taints = list(node.taints) + [Taint(key="edited")]
+            cluster.touch_node(node)
+        if step % 5 == 4:
+            assert_gather_matches_scratch(cluster, reps)
+
+    assert_gather_matches_scratch(cluster, reps)
+    # exclusion masking (the consolidation probe shape) stays exact too
+    if cluster.nodes:
+        some = sorted(cluster.nodes)[: max(1, len(cluster.nodes) // 2)]
+        assert_gather_matches_scratch(cluster, reps, exclude=tuple(some))
+
+
+def test_bind_and_rebind_refresh_used_rows():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    provision(cluster, prov, [cpu_pod() for _ in range(4)])
+    reps = class_reps()
+    assert_gather_matches_scratch(cluster, reps)
+    # rebind a pod across nodes: both the old and new rows must refresh
+    names = sorted(cluster.nodes)
+    if len(names) >= 2:
+        pod = next(p for p in cluster.pods.values()
+                   if p.node_name == names[0])
+        cluster.bind_pod(pod, names[1])
+        assert_gather_matches_scratch(cluster, reps)
+    # unbind releases the row's pod count
+    pod = next(p for p in cluster.pods.values() if p.node_name)
+    cluster.unbind_pod(pod)
+    assert_gather_matches_scratch(cluster, reps)
+
+
+# ---------------------------------------------------------------------------
+# compaction and slab growth
+# ---------------------------------------------------------------------------
+
+def test_forced_compaction_preserves_bit_identity():
+    clock, cloud, provider, cluster, prov, ctrl = env(
+        arena_kwargs={"compact_floor": 2})
+    reps = class_reps()
+    # seed a fleet directly (one node per add — the provisioner would pack),
+    # then shrink it below the tombstone threshold so compact() must fire
+    from karpenter_tpu.api.objects import Node
+    from karpenter_tpu.api.resources import CPU, MEMORY, PODS, ResourceList
+    for i in range(10):
+        cluster.add_node(Node(
+            name=f"drip-{i:03d}",
+            allocatable=ResourceList({CPU: 4000, MEMORY: 8 * 2 ** 30,
+                                      PODS: 110}),
+            labels={wk.INSTANCE_TYPE: "a.medium", wk.ZONE: "zone-a"}))
+    assert len(cluster.nodes) >= 6
+    before = cluster.arena.compactions
+    for name in sorted(cluster.nodes)[:-2]:
+        cluster.remove_node(name)
+        assert_gather_matches_scratch(cluster, reps)
+    assert cluster.arena.compactions > before
+    # the invariant, not an exact count: tombstones never exceed the floor
+    assert cluster.arena.tombstone_count <= max(
+        cluster.arena.compact_floor, cluster.arena.live_count)
+    assert_gather_matches_scratch(cluster, reps)
+    # and the slab keeps working after re-growth over recycled slots
+    provision(cluster, prov, [cpu_pod() for _ in range(3)])
+    assert_gather_matches_scratch(cluster, reps)
+
+
+def test_class_table_wholesale_reset():
+    """Past class_table_max the registry resets; every requested rep must
+    still get a correct fresh column."""
+    clock, cloud, provider, cluster, prov, ctrl = env(
+        arena_kwargs={"class_table_max": 2})
+    provision(cluster, prov, [cpu_pod() for _ in range(2)])
+    reps = class_reps()  # 4 distinct classes > class_table_max
+    assert_gather_matches_scratch(cluster, reps)
+    # and again with a different rep mix (second reset path)
+    more = [cpu_pod(cpu_m=333, mem_mib=333),
+            cpu_pod(cpu_m=444, mem_mib=444),
+            cpu_pod(cpu_m=555, mem_mib=555)]
+    assert_gather_matches_scratch(cluster, more)
+
+
+# ---------------------------------------------------------------------------
+# fallback contract: anything the slab can't express returns None
+# ---------------------------------------------------------------------------
+
+def test_gather_falls_back_on_extra_axes_and_custom_scales():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    provision(cluster, prov, [cpu_pod()])
+    reps = class_reps()
+    extra_axes = tuple(DEFAULT_AXES) + ("nvidia.com/gpu",)
+    assert cluster.arena.gather(reps, axes=extra_axes) is None
+    odd_scales = dict(DEFAULT_SCALES)
+    next(iter(odd_scales))  # keep keys, perturb one value
+    k = sorted(odd_scales)[0]
+    odd_scales[k] = odd_scales[k] * 2
+    assert cluster.arena.gather(reps, scales=odd_scales) is None
+    # default axes + scales identical to defaults stay warm
+    assert cluster.arena.gather(reps, scales=dict(DEFAULT_SCALES)) is not None
+
+
+def test_gather_refuses_untracked_or_swapped_node_then_rebuilds():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    provision(cluster, prov, [cpu_pod()])
+    reps = class_reps()
+    name = sorted(cluster.nodes)[0]
+    # swap the node object behind the arena's back (no delta fired): the
+    # object-identity check must refuse the stale row
+    import copy
+    cluster.nodes[name] = copy.deepcopy(cluster.nodes[name])
+    assert cluster.arena.gather(reps) is None
+    # rebuild() is the always-correct fallback
+    cluster.arena.rebuild()
+    assert_gather_matches_scratch(cluster, reps)
+
+
+def test_invalidate_triggers_rebuild_on_next_gather():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    provision(cluster, prov, [cpu_pod()])
+    reps = class_reps()
+    cluster.arena.invalidate("test")
+    assert cluster.arena._needs_rebuild
+    assert_gather_matches_scratch(cluster, reps)  # gather rebuilt inline
+    assert not cluster.arena._needs_rebuild
+
+
+def test_epoch_advances_on_every_delta_kind():
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    e0 = cluster.arena.epoch
+    pod = cpu_pod()
+    cluster.add_pod(pod)
+    assert cluster.arena.epoch > e0
+    e1 = cluster.arena.epoch
+    provision(cluster, prov, [cpu_pod()])
+    assert cluster.arena.epoch > e1
+    e2 = cluster.arena.epoch
+    cluster.delete_pod(pod)
+    assert cluster.arena.epoch > e2
+    e3 = cluster.arena.epoch
+    cluster.arena.apply_offering_change()
+    assert cluster.arena.epoch > e3
+
+
+# ---------------------------------------------------------------------------
+# disruption's fingerprint-keyed size-1 arena cache
+# ---------------------------------------------------------------------------
+
+def build_underutilized(cluster, prov, rng, n_groups=5):
+    for _ in range(n_groups):
+        k = int(rng.integers(1, 4))
+        pods = [cpu_pod(cpu_m=int(rng.integers(200, 1800)),
+                        mem_mib=int(rng.integers(256, 3000)))
+                for _ in range(k)]
+        provision(cluster, prov, pods)
+    all_pods = list(cluster.pods.values())
+    rng.shuffle(all_pods)
+    for p in all_pods[:int(len(all_pods) * 0.6)]:
+        cluster.delete_pod(p)
+
+
+def test_arena_cache_hits_across_rebuilt_candidates():
+    """Fingerprint agreement: candidates are rebuilt objects every
+    reconcile, but with an unchanged mutation_epoch the field-level match
+    must reuse the cached SimulationArena (the size-1 cache)."""
+    rng = np.random.default_rng(7)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng)
+    cands = ctrl.candidates()
+    assert len(cands) >= 2
+    a1 = ctrl._arena_for(cands)
+    assert ctrl._arena_for(cands) is a1
+    # a fresh candidate list over the SAME cluster state still hits
+    cands2 = ctrl.candidates()
+    assert any(c2 is not c1 for c1, c2 in zip(cands, cands2))
+    assert ctrl._arena_for(cands2) is a1
+
+
+def test_arena_cache_invalidated_by_cluster_mutation():
+    rng = np.random.default_rng(9)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng)
+    cands = ctrl.candidates()
+    a1 = ctrl._arena_for(cands)
+    # a fingerprint-visible mutation (deleting a bound pod changes the
+    # bound-pod walk) must miss the size-1 cache and rebuild
+    bound = sorted((p for p in cluster.pods.values() if p.node_name),
+                   key=lambda p: p.uid)
+    cluster.delete_pod(bound[0])
+    cands2 = ctrl.candidates()
+    a2 = ctrl._arena_for(cands2)
+    assert a2 is not a1
+
+
+# ---------------------------------------------------------------------------
+# lazy-face staleness regression (ISSUE 7 satellite 6)
+# ---------------------------------------------------------------------------
+
+def test_sweep_faces_invalidated_by_interleaved_bind():
+    """The delete/replace faces are built lazily; a bind BETWEEN sweeps
+    (provisioning landed a pod mid-reconcile) must drop cached faces so the
+    next sweep sees the new `used` rows — the stale-face hazard."""
+    rng = np.random.default_rng(11)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng)
+    cands = ctrl.candidates()
+    assert len(cands) >= 2
+    arena = ctrl._arena_for(cands)
+    side_before = arena.delete_side            # builds + caches the face
+    assert arena.delete_side is side_before    # cached while epoch holds
+
+    # interleaved external bind: land a pod on a surviving (non-candidate)
+    # node via the provisioner
+    provision(cluster, prov, [cpu_pod(cpu_m=300, mem_mib=256)])
+
+    side_after = arena.delete_side             # must have been invalidated
+    assert side_after is not side_before
+
+    # and the refreshed face equals a from-scratch arena over the new state
+    from karpenter_tpu.ops.tensorize import SimulationArena
+    fresh = SimulationArena(cands, cluster, provider.get_instance_types(),
+                            list(ctrl.nodepools.values()))
+    f = fresh.delete_side
+    assert [n.name for n in side_after.node_list] == \
+        [n.name for n in f.node_list]
+    np.testing.assert_array_equal(side_after.alloc, f.alloc)
+    np.testing.assert_array_equal(side_after.used, f.used)
+    np.testing.assert_array_equal(side_after.compat, f.compat)
+    # the pre-bind face really was stale: used rows differ somewhere
+    assert side_before.used.shape != f.used.shape or \
+        not np.array_equal(side_before.used, f.used)
+
+
+def test_warm_and_cold_faces_are_bit_identical():
+    """The SimulationArena face built through the warm ClusterArena gather
+    must equal the face built with the gate off (pure tensorize_nodes)."""
+    rng = np.random.default_rng(13)
+    clock, cloud, provider, cluster, prov, ctrl = env()
+    build_underutilized(cluster, prov, rng)
+    cands = ctrl.candidates()
+    assert cands
+    from karpenter_tpu.ops.tensorize import SimulationArena
+    warm = SimulationArena(cands, cluster, provider.get_instance_types(),
+                           list(ctrl.nodepools.values()))
+    w = warm.delete_side
+    arena, cluster.arena = cluster.arena, None
+    try:
+        cold = SimulationArena(cands, cluster, provider.get_instance_types(),
+                               list(ctrl.nodepools.values()))
+        c = cold.delete_side
+    finally:
+        cluster.arena = arena
+    assert [n.name for n in w.node_list] == [n.name for n in c.node_list]
+    np.testing.assert_array_equal(w.alloc, c.alloc)
+    np.testing.assert_array_equal(w.used, c.used)
+    np.testing.assert_array_equal(w.compat, c.compat)
+    np.testing.assert_array_equal(w.cand_counts, c.cand_counts)
+    np.testing.assert_array_equal(w.cand_cols, c.cand_cols)
+
+
+# ---------------------------------------------------------------------------
+# gate plumbing
+# ---------------------------------------------------------------------------
+
+def test_harness_gate_off_detaches_arena():
+    from karpenter_tpu.sim import SimHarness
+    from karpenter_tpu.sim.scenario import Scenario, Wave
+    sc = Scenario(name="gate", duration_s=600.0, settle_s=60.0,
+                  catalog_size=4,
+                  workload=[Wave(kind="step", name="svc", at_s=30.0,
+                                 count=2, duration_s=0.0,
+                                 cpu_m=(250, 500), mem_mib=(256, 512))])
+    assert SimHarness(sc, seed=0, incremental_arena=False).cluster.arena \
+        is None
+    assert SimHarness(sc, seed=0).cluster.arena is not None
+    assert SimHarness(sc, seed=0,
+                      incremental_arena=True).cluster.arena is not None
+
+
+def test_options_flag_and_gate_default():
+    from karpenter_tpu.operator.options import Options
+    assert Options().gate("IncrementalArena")
+    opts = Options.from_args(["--feature-gates", "IncrementalArena=false"])
+    assert not opts.gate("IncrementalArena")
+    opts = Options.from_args(["--incremental-arena"])
+    assert opts.gate("IncrementalArena")

@@ -15,6 +15,7 @@ import pytest
 from karpenter_tpu.analysis import (
     Finding, RULES, SourceFile, default_checkers, iter_sources,
     load_baseline, partition, run_analysis)
+from karpenter_tpu.analysis.arena import ArenaDisciplineChecker
 from karpenter_tpu.analysis.core import is_suppressed
 from karpenter_tpu.analysis.determinism import DeterminismChecker
 from karpenter_tpu.analysis.jaxhot import JaxHotPathChecker
@@ -588,4 +589,80 @@ def test_cli_json_and_list_rules():
 def test_default_checkers_cover_all_families():
     fams = {c.family for c in default_checkers()}
     assert fams == {"jax-hotpath", "determinism", "lock-discipline",
-                    "observability"}
+                    "observability", "arena-discipline"}
+
+
+# ---------------------------------------------------------------------------
+# arena-discipline fixtures
+# ---------------------------------------------------------------------------
+
+def test_ar001_slab_write_outside_arena_module():
+    src = """
+        def poke(arena, slot):
+            arena.slab_used[slot] = 0.0
+            arena.slab_live[slot] = False
+    """
+    out = ArenaDisciplineChecker().check_file(
+        _sf(src, "karpenter_tpu/controllers/x.py"))
+    assert _rules(out) == ["AR001", "AR001"]
+
+
+def test_ar001_covers_augassign_del_and_fill():
+    src = """
+        def poke(self):
+            self.slab_alloc[0] += 1.0
+            del self.slab_compat[0]
+            self.slab_used.fill(0)
+    """
+    out = ArenaDisciplineChecker().check_file(
+        _sf(src, "karpenter_tpu/ops/other.py"))
+    assert _rules(out) == ["AR001", "AR001", "AR001"]
+
+
+def test_ar001_reads_and_other_attrs_are_clean():
+    src = """
+        def read(arena, idx):
+            rows = arena.slab_alloc[idx]
+            arena.other_buf[0] = 1.0
+            return rows
+    """
+    out = ArenaDisciplineChecker().check_file(
+        _sf(src, "karpenter_tpu/controllers/x.py"))
+    assert _rules(out) == []
+
+
+def test_ar002_unannotated_mutator_in_arena_module():
+    src = """
+        class ClusterArena:
+            def apply_thing(self, slot):
+                self.slab_used[slot] = 0.0
+    """
+    out = ArenaDisciplineChecker().check_file(
+        _sf(src, "karpenter_tpu/ops/arena.py"))
+    assert _rules(out) == ["AR002"]
+
+
+def test_ar002_annotated_mutator_and_init_are_clean():
+    src = """
+        import numpy as np
+
+        class ClusterArena:
+            def __init__(self):
+                self.slab_used = np.zeros((4, 2))
+
+            def apply_thing(self, slot):  # guarded-by: caller(state_lock)
+                self.slab_used[slot] = 0.0
+
+            def helper(self, slot):  # graftlint: holds(state_lock)
+                self.slab_used[slot] = 1.0
+    """
+    out = ArenaDisciplineChecker().check_file(
+        _sf(src, "karpenter_tpu/ops/arena.py"))
+    assert _rules(out) == []
+
+
+def test_arena_module_itself_is_clean():
+    srcs = [sf for sf in iter_sources(REPO)
+            if sf.rel == "karpenter_tpu/ops/arena.py"]
+    assert srcs, "ops/arena.py not found"
+    assert _rules(ArenaDisciplineChecker().check_file(srcs[0])) == []

@@ -239,6 +239,9 @@ GOLDEN_CASES = [
     ("diurnal-forecast", "diurnal-forecast.yaml", 7200.0),
     ("spot-reclaim-storm-forecast", "spot-reclaim-storm-forecast.yaml",
      7200.0),
+    # 100ms-cadence churn through the warm incremental arena; truncated
+    # hard because each virtual second is ~10 consolidation sweeps
+    ("steady-state-drip", "steady-state-drip.yaml", 300.0),
 ]
 
 
@@ -258,6 +261,23 @@ def test_golden_report(name, fname, duration):
         assert got == fh.read(), (
             f"report for {fname} (seed 0, {duration:.0f}s) drifted from "
             f"{path}; if the change is intentional, regenerate the golden")
+
+
+@pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
+                         ids=[c[0] for c in GOLDEN_CASES])
+def test_golden_report_arena_gate_off(name, fname, duration):
+    """The IncrementalArena gate must be a pure optimization: replaying
+    every canned scenario with the gate OFF (the exact pre-arena full
+    tensorize_nodes code paths) must reproduce the goldens byte-for-byte."""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     incremental_arena=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"gate-off report for {fname} diverged from {path}: the arena "
+            f"changed behavior, not just latency")
 
 
 # ---------------------------------------------------------------------------
