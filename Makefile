@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip benchmark-interruption trace-demo sim-demo deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet benchmark-interruption trace-demo sim-demo deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -33,6 +33,9 @@ bench-forecast: ## Predictive-headroom A/B: diurnal-forecast on vs off (one JSON
 
 bench-drip: ## Steady-state drip: 50k-pod incremental-arena delta ticks vs full rebuild (one JSON line)
 	python bench.py --drip
+
+bench-megafleet: ## 1M-pod partitioned solve: weak-scaling 1→8 shards + full-decode e2e (one JSON line)
+	python bench.py --megafleet
 
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py

@@ -471,6 +471,204 @@ def run_interruption_benchmark(sizes=(100, 1000, 5000, 15000)):
             f"({r['seconds']}s, fleet={r['recycled_nodes']})")
 
 
+def _megafleet_problem(n_units, pods_per_unit=None, free_frac=0.005):
+    """Synthetic fleet-scale Problem: n_units compat-disjoint zone groups
+    (2 zones × 4 launch options × 64 pod classes each), pods_per_unit pods
+    per unit (KARPENTER_TPU_MEGAFLEET_UNIT, default 125k — 8 units ≈ 1M).
+    63 classes per unit are unit-pinned (shardable structure); one class
+    per unit is zone-free — compatible with every option fleet-wide — the
+    straddling residual the partitioned driver reconciles host-side.
+    free_frac=0 builds the fully-shardable variant the weak-scaling curve
+    uses, where the sharded plan must match single-device exactly.
+
+    Built directly as dense arrays: tensorize() at 1M pods would spend
+    the bench budget on pod-object churn the solver never touches; the
+    solver contract is the Problem arrays, which is what a scale bench
+    must stress."""
+    from karpenter_tpu.ops.tensorize import LaunchOption, Problem
+    if pods_per_unit is None:
+        pods_per_unit = int(os.environ.get(
+            "KARPENTER_TPU_MEGAFLEET_UNIT", "125000"))
+    free = int(round(pods_per_unit * free_frac))
+    pinned = pods_per_unit - free
+    zones, options, alloc_rows, price_rows, zone_rows = [], [], [], [], []
+    req_rows, count_rows, class_unit = [], [], []
+    for u in range(n_units):
+        za, zb = f"z{u}a", f"z{u}b"
+        zones += [za, zb]
+        for zi, z in ((2 * u, za), (2 * u + 1, zb)):
+            for ti, (cpu, mem, price) in enumerate(
+                    ((128, 512, 1.0), (256, 1024, 1.9))):
+                options.append(LaunchOption(
+                    pool=f"pool-{u}", instance_type=f"mf-{ti}", zone=z,
+                    capacity_type="on-demand", price=price,
+                    type_index=ti, pool_index=u))
+                alloc_rows.append((cpu, mem))
+                price_rows.append(price)
+                zone_rows.append(zi)
+        for c in range(63):
+            cpu = (1, 2, 4)[c % 3]
+            req_rows.append((cpu, 4 * cpu))
+            count_rows.append(pinned // 63 + (1 if c < pinned % 63 else 0))
+            class_unit.append(u)
+        if free:
+            req_rows.append((2, 8))
+            count_rows.append(free)
+            class_unit.append(-1)  # fleet-wide compat → residual
+    O = len(options)
+    counts = np.asarray(count_rows, np.int32)
+    C = len(counts)
+    compat = np.zeros((C, O), bool)
+    for ci, u in enumerate(class_unit):
+        if u < 0:
+            compat[ci, :] = True
+        else:
+            compat[ci, 4 * u:4 * u + 4] = True
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    members = [np.arange(s, s + k, dtype=np.int64)
+               for s, k in zip(starts, counts)]
+    return Problem(
+        axes=("cpu", "memory"),
+        class_requests=np.asarray(req_rows, np.float32),
+        class_counts=counts, class_compat=compat, class_members=members,
+        options=options,
+        option_alloc=np.asarray(alloc_rows, np.float32),
+        option_price=np.asarray(price_rows, np.float32),
+        option_rank=np.zeros(O, np.int32),
+        class_node_cap=np.full(C, 2**30, np.int32),
+        option_zone=np.asarray(zone_rows, np.int32),
+        option_captype=np.zeros(O, np.int32),
+        zones=zones, pods=[], scales={"cpu": 1.0, "memory": 1.0})
+
+
+def _nodes_per_option(problem, result):
+    oi = {id(o): j for j, o in enumerate(problem.options)}
+    out = np.zeros(problem.num_options, np.int64)
+    for nd in result.nodes:
+        out[oi[id(nd.option)]] += 1
+    return out
+
+
+def run_megafleet(shard_counts=(1, 2, 4, 8), iters=3):
+    """`make bench-megafleet`: the fleet-scale partitioned-solve proof.
+
+    Weak scaling: at each n the problem grows with the mesh (n units of
+    ~125k pods), so per-shard work is constant; speedup(n) :=
+    T_single_device(problem(n)) / T_partitioned_n(problem(n)).  On a
+    single-core CPU host the curve measures the ALGORITHMIC win alone —
+    per-shard class compaction cuts the kernel's C_total × K_total
+    cross-term to n × (C/n × K/n) — so `host_cores` rides in the tail
+    and the acceptance bar is the monotone ≥3x curve, not wall-clock.
+    Plans must match single-device exactly (nodes_per_option, int
+    compare) — a fast wrong decomposition is worthless.
+
+    Then one full-decode 8-unit (~1M pod) end-to-end pass with the
+    zone-free residual classes in, recording reconcile metrics."""
+    import jax
+    from karpenter_tpu.ops.classpack import solve_classpack
+    from karpenter_tpu.parallel import make_pod_mesh, solve_partitioned
+    from karpenter_tpu.parallel.partition import plan_partition
+
+    n_dev = len(jax.devices())
+
+    def best_of(fn, n_iters=iters):
+        fn()  # warm: jit compile + memo fills are not the claim
+        best, out = float("inf"), None
+        for _ in range(n_iters):
+            t0 = time.perf_counter()
+            out = fn()
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        return best, out
+
+    curve = []
+    for n in shard_counts:
+        if n > n_dev:
+            log(f"[megafleet-{n}] skipped: only {n_dev} devices visible")
+            continue
+        prob = _megafleet_problem(n, free_frac=0.0)
+        pods = int(prob.class_counts.sum())
+        t_single, r_single = best_of(
+            lambda: solve_classpack(prob, max_nodes=4096 * n,
+                                    decode=False, guide=None))
+        single_npo = _nodes_per_option(prob, r_single)
+        entry = {"shards": n, "pods": pods,
+                 "t_single_ms": round(t_single, 2)}
+        if n >= 2:
+            mesh = make_pod_mesh(n)
+            t_shard, out = best_of(
+                lambda: solve_partitioned(prob, mesh=mesh, decode=False,
+                                          max_nodes_per_shard=4096))
+            assert out is not None, "planner found no structure at n>=2"
+            cost, npo, unsched = out
+            assert unsched == 0 and len(r_single.unschedulable) == 0
+            plan_parity = bool(np.array_equal(single_npo, npo))
+            assert plan_parity, \
+                f"sharded plan diverged at n={n}: {single_npo} vs {npo}"
+            assert abs(cost - r_single.total_price) <= \
+                1e-5 * max(1.0, abs(cost)), \
+                f"cost diverged at n={n}: {cost} vs {r_single.total_price}"
+            entry.update(t_sharded_ms=round(t_shard, 2),
+                         speedup=round(t_single / t_shard, 3),
+                         plan_parity=plan_parity)
+        else:
+            entry.update(t_sharded_ms=None, speedup=1.0, plan_parity=True)
+        curve.append(entry)
+        log(f"[megafleet-{n}] pods={pods} single={entry['t_single_ms']}ms "
+            f"sharded={entry['t_sharded_ms']}ms "
+            f"speedup={entry['speedup']}x")
+
+    # full-decode end-to-end with the straddling residual in
+    e2e = {}
+    n_e2e = max(n for n in shard_counts if n <= n_dev)
+    if n_e2e >= 2:
+        prob = _megafleet_problem(n_e2e)
+        total = int(prob.class_counts.sum())
+        mesh = make_pod_mesh(n_e2e)
+        plan = plan_partition(prob, n_e2e)
+        assert plan is not None
+        t0 = time.perf_counter()
+        res = solve_partitioned(prob, mesh=mesh, decode=True,
+                                max_nodes_per_shard=4096, plan=plan)
+        e2e_ms = (time.perf_counter() - t0) * 1000.0
+        placed = sum(len(nd.pod_indices) for nd in res.nodes) + \
+            len(res.existing_assignments)
+        assert placed + len(res.unschedulable) == total, \
+            f"decode lost pods: {placed}+{len(res.unschedulable)} != {total}"
+        e2e = {
+            "megafleet_e2e_ms": round(e2e_ms, 1),
+            "megafleet_e2e_pods": total,
+            "megafleet_e2e_shards": n_e2e,
+            "megafleet_e2e_unschedulable": len(res.unschedulable),
+            "megafleet_residual_pods": plan.residual_pods,
+            "megafleet_residual_pct": round(
+                100.0 * plan.residual_pods / plan.total_pods, 3),
+            "megafleet_imbalance": round(plan.imbalance, 3),
+        }
+        log(f"[megafleet-e2e] pods={total} shards={n_e2e} "
+            f"decode={e2e_ms:.0f}ms residual={plan.residual_pods} "
+            f"({e2e['megafleet_residual_pct']}%) "
+            f"unsched={len(res.unschedulable)}")
+
+    top = curve[-1] if curve else {}
+    tail = {
+        "metric": f"megafleet weak-scaling speedup at "
+                  f"{top.get('shards', 0)} shards (partitioned vs "
+                  f"single-device, equal plans)",
+        "value": top.get("speedup"),
+        "unit": "x",
+        "vs_baseline": round(top.get("speedup", 0.0) / 3.0, 3)
+        if top.get("speedup") else None,
+        "megafleet_weak_scaling": curve,
+        "megafleet_shard_counts": [c["shards"] for c in curve],
+        "megafleet_monotone": all(
+            curve[i]["speedup"] <= curve[i + 1]["speedup"]
+            for i in range(len(curve) - 1)),
+        "host_cores": os.cpu_count(),
+    }
+    tail.update(e2e)
+    return tail
+
+
 def _backend_fields(platform):
     """Backend provenance for every JSON tail: what the orchestrator asked
     for (`auto` = subprocess discovery), what the child actually ran on,
@@ -545,7 +743,7 @@ def _run_child(env, timeout=3000):
     bench = os.path.abspath(__file__)
     args = [sys.executable, bench, "--run"]
     for flag in ("--smoke", "--consolidation", "--sim", "--forecast",
-                 "--drip"):
+                 "--drip", "--megafleet"):
         if flag in sys.argv[1:]:
             args.append(flag)
     try:
@@ -568,10 +766,17 @@ def main():
     requested = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() \
         or "auto"
     os.environ["KARPENTER_TPU_BENCH_REQUESTED"] = requested
+    # the megafleet stage needs a mesh: 8 virtual CPU devices whenever the
+    # backend resolves to cpu (a real TPU env brings its own chips)
+    megafleet = "--megafleet" in sys.argv[1:]
     plat = _probe_backend()
     if plat is not None:
         log(f"backend probe: {plat} ok")
-        rc = _run_child(dict(os.environ))
+        env = dict(os.environ)
+        if megafleet and plat == "cpu":
+            env = _virtual_cpu_env(n_devices=8)
+            env["KARPENTER_TPU_BENCH_REQUESTED"] = requested
+        rc = _run_child(env)
         if rc == 0:
             return
         reason = f"run on probed platform {plat} failed rc={rc}"
@@ -579,7 +784,7 @@ def main():
     else:
         reason = "backend probe failed (bounded timeout)"
         log(f"{reason} — falling back to cpu platform")
-    env = _virtual_cpu_env(n_devices=1)
+    env = _virtual_cpu_env(n_devices=8 if megafleet else 1)
     env["KARPENTER_TPU_BENCH_REQUESTED"] = requested
     env["KARPENTER_TPU_BENCH_FALLBACK"] = reason
     rc = _run_child(env)
@@ -587,11 +792,17 @@ def main():
 
 
 def run_all(smoke=False, consolidation=False, sim=False, forecast=False,
-            drip=False):
+            drip=False, megafleet=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
     rng = np.random.default_rng(42)
+
+    if megafleet:
+        # `make bench-megafleet`: 1M-pod partitioned-solve weak scaling
+        # (1→2→4→8 shards) + full-decode e2e with residual reconciliation
+        _emit(run_megafleet(), platform)
+        return
 
     if drip:
         # `make bench-drip`: 50k-pod steady-state churn through the
@@ -745,6 +956,7 @@ if __name__ == "__main__":
                 consolidation="--consolidation" in sys.argv[1:],
                 sim="--sim" in sys.argv[1:],
                 forecast="--forecast" in sys.argv[1:],
-                drip="--drip" in sys.argv[1:])
+                drip="--drip" in sys.argv[1:],
+                megafleet="--megafleet" in sys.argv[1:])
     else:
         main()

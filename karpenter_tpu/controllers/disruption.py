@@ -47,6 +47,7 @@ from ..ops.constraints import (LEVEL_REQUIRED_ONLY,
                                make_zone_feasibility)
 from ..ops.ffd import PackingResult
 from ..ops.tensorize import Problem, tensorize
+from ..parallel.driver import maybe_solve_partitioned
 from ..state.cluster import Cluster
 from ..utils import metrics, tracing
 from ..utils.events import Event
@@ -179,7 +180,8 @@ class DisruptionController:
                  # simulation arena (≤3 aggregate device calls per tick);
                  # False = the original sequential binary-search +
                  # per-candidate screen loop
-                 batched_sweep: bool = True):
+                 batched_sweep: bool = True,
+                 sharded_solve: bool = False):
         from ..utils.events import Recorder
         self.provider = provider
         self.cluster = cluster
@@ -193,6 +195,11 @@ class DisruptionController:
         self.spot_min_flexibility = spot_min_flexibility
         self.lp_guide = lp_guide
         self.batched_sweep = batched_sweep
+        # ShardedSolve feature gate: fleet-scale decoded simulations go
+        # through the partitioned driver (parallel/driver.py); probes
+        # (decode=False) stay on the aggregate kernel — they are already
+        # cheap and batched.
+        self.sharded_solve = sharded_solve
         self._empty_since: Dict[str, float] = {}  # node → first seen empty
         self._arena_cache = None  # (fingerprint, SimulationArena)
         # (mutation_epoch, catalog_key, candidates, fingerprint) — skips the
@@ -353,16 +360,25 @@ class DisruptionController:
                 nodes=[], unschedulable=list(range(len(pods))),
                 existing_assignments={}, total_price=0.0)
             return problem, result, node_list
-        result = solve_classpack(
-            problem,
-            existing_alloc=alloc if len(node_list) else None,
-            existing_used=used if len(node_list) else None,
-            existing_compat=compat if len(node_list) else None,
-            decode=decode,
-            # the LPGuide gate covers THIS path too: a fresh replacement
-            # solve (all candidates excluded, no survivors) would
-            # otherwise run the guide despite the escape hatch
-            guide="lp" if self.lp_guide else None)
+        result = None
+        if decode and self.sharded_solve:
+            result = maybe_solve_partitioned(
+                problem, path="disruption", max_nodes=2048,
+                existing_alloc=alloc if len(node_list) else None,
+                existing_used=used if len(node_list) else None,
+                existing_compat=compat if len(node_list) else None,
+                node_list=node_list)
+        if result is None:
+            result = solve_classpack(
+                problem,
+                existing_alloc=alloc if len(node_list) else None,
+                existing_used=used if len(node_list) else None,
+                existing_compat=compat if len(node_list) else None,
+                decode=decode,
+                # the LPGuide gate covers THIS path too: a fresh replacement
+                # solve (all candidates excluded, no survivors) would
+                # otherwise run the guide despite the escape hatch
+                guide="lp" if self.lp_guide else None)
         if decode:
             # intra-batch anti-affinity/spread the masks can't express: a
             # violated placement disqualifies the whole action (the

@@ -36,6 +36,7 @@ from ..ops.classpack import solve_classpack
 from ..ops.ffd import (NATIVE_CUTOVER_ROWS, NodeDecision, PackingResult,
                        solve_ffd)
 from ..ops.tensorize import Problem, tensorize
+from ..parallel.driver import maybe_solve_partitioned
 from ..state.cluster import Cluster
 from ..utils import metrics, tracing
 from ..utils.events import Event
@@ -107,7 +108,8 @@ class Provisioner:
                  lp_guide: bool = True,
                  refinery=None,
                  recorder=None,
-                 provenance=None):
+                 provenance=None,
+                 sharded_solve: bool = False):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
@@ -118,6 +120,11 @@ class Provisioner:
         self.provenance = provenance
         self.max_nodes_per_round = max_nodes_per_round
         self.solver = solver
+        # ShardedSolve feature gate: partition fleet-scale batches across
+        # devices (parallel/driver.py); maybe_solve_partitioned returns None
+        # for small/unshardable batches and the round falls through to the
+        # single-device path below.
+        self.sharded_solve = sharded_solve
         # the LPGuide feature gate: False routes classpack solves straight
         # to the greedy (guide=None) — the operational escape hatch.
         # With a refinery (LPRefinery gate), guide misses never block the
@@ -250,20 +257,44 @@ class Provisioner:
                             problem.class_reps, problem.axes,
                             scales=problem.scales, nodes=node_view)
                     node_list, alloc, used, compat = gathered
-                    solve = self._pick_solver(problem, n_existing=len(node_list))
-                    psp.annotate(
-                        solver="ffd" if solve is solve_ffd else "classpack",
-                        rows=int(problem.class_counts.sum()) + len(node_list))
-                    result = solve(problem, max_nodes=self.max_nodes_per_round,
-                                   existing_alloc=alloc, existing_used=used,
-                                   existing_compat=compat)
+                    result = None
+                    if self.sharded_solve:
+                        result = maybe_solve_partitioned(
+                            problem, path="provisioning",
+                            max_nodes=self.max_nodes_per_round,
+                            existing_alloc=alloc, existing_used=used,
+                            existing_compat=compat, node_list=node_list)
+                    if result is not None:
+                        psp.annotate(
+                            solver="sharded",
+                            rows=int(problem.class_counts.sum()) + len(node_list))
+                    else:
+                        solve = self._pick_solver(problem,
+                                                  n_existing=len(node_list))
+                        psp.annotate(
+                            solver="ffd" if solve is solve_ffd else "classpack",
+                            rows=int(problem.class_counts.sum()) + len(node_list))
+                        result = solve(problem,
+                                       max_nodes=self.max_nodes_per_round,
+                                       existing_alloc=alloc, existing_used=used,
+                                       existing_compat=compat)
                     result._existing_nodes = node_list
                 else:
-                    solve = self._pick_solver(problem)
-                    psp.annotate(
-                        solver="ffd" if solve is solve_ffd else "classpack",
-                        rows=int(problem.class_counts.sum()))
-                    result = solve(problem, max_nodes=self.max_nodes_per_round)
+                    result = None
+                    if self.sharded_solve:
+                        result = maybe_solve_partitioned(
+                            problem, path="provisioning",
+                            max_nodes=self.max_nodes_per_round)
+                    if result is not None:
+                        psp.annotate(solver="sharded",
+                                     rows=int(problem.class_counts.sum()))
+                    else:
+                        solve = self._pick_solver(problem)
+                        psp.annotate(
+                            solver="ffd" if solve is solve_ffd else "classpack",
+                            rows=int(problem.class_counts.sum()))
+                        result = solve(problem,
+                                       max_nodes=self.max_nodes_per_round)
                     result._existing_nodes = []
                 psp.annotate(scheduled=result.scheduled_count,
                              unschedulable=len(result.unschedulable))

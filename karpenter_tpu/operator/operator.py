@@ -341,7 +341,8 @@ def build_controllers(op: Operator) -> Dict[str, object]:
         lp_guide=op.options.gate("LPGuide"),
         refinery=refinery,
         recorder=op.recorder,
-        provenance=op.provenance)
+        provenance=op.provenance,
+        sharded_solve=op.options.gate("ShardedSolve"))
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {
@@ -352,7 +353,8 @@ def build_controllers(op: Operator) -> Dict[str, object]:
             terminator=terminator, clock=op.clock,
             drift_enabled=op.options.gate("Drift"),
             lp_guide=op.options.gate("LPGuide"),
-            recorder=op.recorder),
+            recorder=op.recorder,
+            sharded_solve=op.options.gate("ShardedSolve")),
         "lifecycle": LifecycleController(
             op.cloud_provider, op.cluster, nodepools=op.nodepools,
             recorder=op.recorder, clock=op.clock),

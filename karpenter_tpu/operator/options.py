@@ -69,10 +69,16 @@ class Options:
     # by default; --feature-gates IncrementalArena=false is the
     # full-rebuild escape hatch (every consumer falls back to
     # tensorize_nodes).  --incremental-arena is the explicit-on shorthand.
+    # ShardedSolve: route large provisioning/consolidation solves through
+    # the partitioned multi-device mesh (parallel/partition.py + driver.py)
+    # — off by default (it is a no-op on <2 devices and the partition
+    # planner falls back whenever the batch has no zone structure); enable
+    # with --sharded-solve or --feature-gates ShardedSolve=true.
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
                                  "LPRefinery": False, "Forecast": False,
-                                 "IncrementalArena": True})
+                                 "IncrementalArena": True,
+                                 "ShardedSolve": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -157,6 +163,12 @@ class Options:
                             "--feature-gates IncrementalArena=true; on by "
                             "default — disable with --feature-gates "
                             "IncrementalArena=false)")
+        p.add_argument("--sharded-solve", action="store_true",
+                       default=False,
+                       help="partition large solves across the device "
+                            "mesh by zone-compatibility group (shorthand "
+                            "for --feature-gates ShardedSolve=true; "
+                            "no-op on <2 devices)")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -192,6 +204,8 @@ class Options:
             opts.feature_gates["Forecast"] = True
         if ns.incremental_arena:
             opts.feature_gates["IncrementalArena"] = True
+        if ns.sharded_solve:
+            opts.feature_gates["ShardedSolve"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts

@@ -1,2 +1,4 @@
+from .driver import maybe_solve_partitioned, solve_partitioned
+from .partition import PartitionPlan, plan_partition
 from .sharded import (DCN_AXIS, ICI_AXIS, SHARD_AXIS, make_host_mesh,
                       make_pod_mesh, solve_sharded, split_counts)

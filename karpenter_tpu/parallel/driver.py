@@ -1,0 +1,399 @@
+"""Partitioned mesh driver: the fleet-scale sharded solve.
+
+`sharded.py` proves pod-batch sharding is a valid bin-packing
+decomposition; this driver makes it FAST at megafleet sizes by feeding
+the mesh `partition.py`'s compatibility groups instead of a round-robin
+count split.  The difference is in the array extents, not just the
+counts: each shard scans ONLY its own classes (compacted + re-padded,
+not the full class list with zeroed counts) against ONLY its own slot
+budget, so the per-shard kernel cost drops from C_total × K_total to
+(C/n) × (K/n) — the structure-exploiting decomposition win (CvxCluster),
+which holds even when the shards execute serially on one host.  On a
+real multi-chip mesh the n-way parallel speedup stacks on top.
+
+Flow per solve:
+
+  1. `plan_partition` buckets classes/options/existing nodes into merged
+     zone-compatibility groups and LPT-balances them over the mesh
+     (span: shard.partition).  A None plan means "no structure" and the
+     caller falls back to the single-device path.
+  2. The compacted per-shard arrays run the unchanged classpack kernels
+     under `shard_map`; per-shard init slabs are donated off-CPU.
+     decode=False reduces the launch plan with a hierarchical `psum` so
+     NodePool-limit checks see the whole fleet (span: shard.solve).
+  3. Pods whose requirements straddle partitions (the plan's residual)
+     are re-solved host-side against the true leftovers — real existing
+     nodes' remaining free space after the mesh pass — and merged into
+     the result (span: shard.reconcile).
+
+Parity: on shardable inputs (no residual, slot budgets not binding) the
+decoded plan is identical to the single-device `solve_classpack`
+(guide=None) plan — each shard's FFD scan sees exactly the classes and
+columns the global scan would have routed to it, in the same relative
+order (tests/test_partitioned.py pins this property over randomized
+clusters at 1/2/4/8 devices).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.classpack import (class_pack_aggregate_kernel,
+                             class_pack_assign_kernel, solve_classpack)
+from ..ops.lpguide import _subproblem
+from ..ops.tensorize import Problem, pad_to
+from ..utils import metrics, tracing
+from .partition import (MAX_RESIDUAL_FRAC_DEFAULT, MIN_PODS_DEFAULT,
+                        PartitionPlan, plan_partition)
+from .sharded import _assemble_plan, _mark_varying, _shard_map, make_pod_mesh
+
+log = logging.getLogger("karpenter.parallel")
+
+# pad buckets for the COMPACTED per-shard axes (smaller low end than the
+# single-device buckets: compaction is the point)
+_CPAD_BUCKETS = (64, 256, 1024, 4096)
+_OPAD_BUCKETS = (512, 2048, 4096, 8192)
+
+
+@partial(jax.jit, static_argnames=("max_nodes_per_shard", "mesh"))
+def _partitioned_pack(requests_sh, counts_sh, compat_sh, node_cap_sh,
+                      alloc, price, rank, max_nodes_per_shard: int,
+                      mesh: Mesh):
+    """Aggregate (feasibility/bench) pack over compacted per-shard class
+    arrays; the launch plan is psum'd hierarchically (ICI first) exactly
+    like `sharded._sharded_pack` so NodePool-limit checks see the fleet."""
+    axes = tuple(mesh.axis_names)
+    u = len(axes)
+
+    def shard_fn(req, cnt, comp, ncap):
+        for _ in range(u):
+            req, cnt, comp, ncap = req[0], cnt[0], comp[0], ncap[0]
+        K = max_nodes_per_shard
+        init_option = _mark_varying(jnp.full((K,), -1, jnp.int32), axes)
+        init_used = _mark_varying(
+            jnp.zeros((K, req.shape[1]), jnp.int32), axes)
+        flat = class_pack_aggregate_kernel(
+            req, cnt, comp, ncap, alloc, price, rank,
+            init_option, init_used, K)
+        for ax in reversed(axes):
+            flat = jax.lax.psum(flat, ax)
+        return flat[(None,) * u]
+
+    fn = _shard_map(shard_fn, mesh=mesh,
+                    in_specs=(P(*axes),) * 4, out_specs=P(*axes))
+    flat = fn(requests_sh, counts_sh, compat_sh, node_cap_sh)
+    for _ in range(u):
+        flat = flat[0]
+    return flat[0], flat[3:].astype(jnp.int32), flat[2].astype(jnp.int32)
+
+
+def _assign_impl(requests_sh, counts_sh, compat_packed_sh, node_cap_sh,
+                 alloc, price, rank, init_opt_sh, init_used_sh,
+                 max_nodes_per_shard: int, n_pods_shard: int, mesh: Mesh):
+    """Decode pack over compacted per-shard class arrays: per-pod slot
+    ids per shard, globalized by the host decode with shard × K offsets."""
+    axes = tuple(mesh.axis_names)
+    u = len(axes)
+
+    def shard_fn(req, cnt, comp, ncap, io, iu):
+        for _ in range(u):
+            req, cnt, comp = req[0], cnt[0], comp[0]
+            ncap, io, iu = ncap[0], io[0], iu[0]
+        assignment, slot_option, n_unsched = class_pack_assign_kernel(
+            req, cnt, comp, ncap, alloc, price, rank, io, iu,
+            max_nodes_per_shard, n_pods_shard)
+        idx = (None,) * u
+        return assignment[idx], slot_option[idx], n_unsched[idx]
+
+    fn = _shard_map(shard_fn, mesh=mesh,
+                    in_specs=(P(*axes),) * 6, out_specs=(P(*axes),) * 3)
+    return fn(requests_sh, counts_sh, compat_packed_sh, node_cap_sh,
+              init_opt_sh, init_used_sh)
+
+
+_partitioned_assign = partial(
+    jax.jit,
+    static_argnames=("max_nodes_per_shard", "n_pods_shard",
+                     "mesh"))(_assign_impl)
+# donate the per-solve init slabs — freshly built host buffers the caller
+# never reads back, so backends that honor donation skip one copy; CPU
+# ignores donation with a warning, so the driver routes there only off-cpu
+_partitioned_assign_donate = partial(
+    jax.jit,
+    static_argnames=("max_nodes_per_shard", "n_pods_shard", "mesh"),
+    donate_argnums=(7, 8))(_assign_impl)
+
+
+def solve_partitioned(problem: Problem, mesh: Optional[Mesh] = None,
+                      max_nodes_per_shard: int = 4096,
+                      decode: bool = True,
+                      existing_alloc: Optional[np.ndarray] = None,
+                      existing_used: Optional[np.ndarray] = None,
+                      existing_compat: Optional[np.ndarray] = None,
+                      existing_zone: Optional[np.ndarray] = None,
+                      plan: Optional[PartitionPlan] = None,
+                      max_residual_frac: float = MAX_RESIDUAL_FRAC_DEFAULT,
+                      min_pods: int = MIN_PODS_DEFAULT):
+    """Partition-aware mesh solve.  Returns None when the planner finds
+    no exploitable structure (caller falls back to the single-device
+    path); otherwise a PackingResult (decode=True) or the aggregate
+    (total_cost, nodes_per_option, unsched) tuple (decode=False, E==0
+    only — the psum cannot attribute fills to existing owners)."""
+    mesh = mesh or make_pod_mesh()
+    n = mesh.devices.size
+    if n < 2:
+        return None
+    E = 0 if existing_alloc is None else len(existing_alloc)
+    C = problem.num_classes
+    ec = None
+    if E:
+        ec = (existing_compat if existing_compat is not None
+              else np.ones((C, E), bool))
+
+    t0 = time.perf_counter()
+    if plan is None:
+        with tracing.span("shard.partition") as sp:
+            plan = plan_partition(problem, n, existing_compat=ec,
+                                  existing_zone=existing_zone,
+                                  max_residual_frac=max_residual_frac,
+                                  min_pods=min_pods)
+            sp.annotate(planned=plan is not None)
+    if plan is None:
+        return None
+    metrics.shard_count().set(n)
+    metrics.shard_imbalance().set(plan.imbalance)
+    metrics.shard_residual_pods().set(plan.residual_pods)
+    metrics.shard_residual_ratio().set(
+        plan.residual_pods / plan.total_pods if plan.total_pods else 0.0)
+    metrics.shard_solve_duration().observe(time.perf_counter() - t0,
+                                           {"phase": "partition"})
+
+    # ---- compacted lowering: per-shard class axis in global FFD order ----
+    t1 = time.perf_counter()
+    order = problem.class_order()
+    R = len(problem.axes)
+    O = problem.num_options
+    Opad = pad_to(O + E, _OPAD_BUCKETS)
+    shard_cls = [order[plan.class_shard[order] == s] for s in range(n)]
+    Cs = max((len(x) for x in shard_cls), default=0)
+    Cpad = pad_to(max(Cs, 1), _CPAD_BUCKETS)
+    K = max_nodes_per_shard
+
+    own = [np.nonzero(plan.existing_shard == s)[0] for s in range(n)]
+    E_max = max((len(o) for o in own), default=0)
+    assert K > E_max, "max_nodes_per_shard must exceed owned existing nodes"
+
+    requests_sh = np.zeros((n, Cpad, R), np.int32)
+    counts_sh = np.zeros((n, Cpad), np.int32)
+    node_cap_sh = np.full((n, Cpad), 2**30, np.int32)
+    compat_sh = np.zeros((n, Cpad, Opad), bool)
+    init_opt = np.full((n, K), -1, np.int32)
+    init_used = np.zeros((n, K, R), np.int32)
+    for s in range(n):
+        cls = shard_cls[s]
+        m = len(cls)
+        if m:
+            requests_sh[s, :m] = problem.class_requests[cls].astype(np.int32)
+            counts_sh[s, :m] = problem.class_counts[cls].astype(np.int32)
+            if problem.class_node_cap is not None:
+                node_cap_sh[s, :m] = problem.class_node_cap[cls]
+            cm = np.zeros((m, Opad), bool)
+            cm[:, :O] = problem.class_compat[cls]
+            if E and len(own[s]):
+                # only the shard's OWN existing columns are visible —
+                # bins never span shards
+                cm[:, O + own[s]] = ec[cls][:, own[s]]
+            compat_sh[s, :m] = cm
+        if E and len(own[s]):
+            # pre-open owned existing nodes in increasing global index
+            # order (the single-device kernel's slot-scan order)
+            init_opt[s, :len(own[s])] = (O + own[s]).astype(np.int32)
+            if existing_used is not None:
+                init_used[s, :len(own[s])] = np.ceil(
+                    existing_used[own[s]]).astype(np.int32)
+
+    alloc = np.zeros((Opad, R), np.int32)
+    alloc[:O] = problem.option_alloc.astype(np.int32)
+    if E:
+        alloc[O:O + E] = np.ceil(existing_alloc).astype(np.int32)
+    price = np.full(Opad, np.inf, np.float32)
+    price[:O] = problem.option_price
+    rank = np.full(Opad, 2**30 - 1, np.int32)
+    rank[:O] = problem.option_rank
+
+    if not decode:
+        assert E == 0, "existing columns require decode=True (the "\
+            "aggregate reduction cannot attribute fills to owners)"
+        shape = mesh.devices.shape
+        with tracing.span("shard.solve") as sp:
+            sp.annotate(shards=n, classes_per_shard=Cs, slots=K)
+            out = _partitioned_pack(
+                jnp.asarray(requests_sh.reshape(*shape, Cpad, R)),
+                jnp.asarray(counts_sh.reshape(*shape, Cpad)),
+                jnp.asarray(compat_sh.reshape(*shape, Cpad, Opad)),
+                jnp.asarray(node_cap_sh.reshape(*shape, Cpad)),
+                jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank),
+                K, mesh)
+            cost, nodes_per_col, unsched = jax.device_get(out)
+        metrics.shard_solve_duration().observe(time.perf_counter() - t1,
+                                               {"phase": "solve"})
+        cost = float(cost)
+        nodes_per_option = np.asarray(nodes_per_col)[:O].astype(np.int64)
+        unsched = int(unsched)
+        t2 = time.perf_counter()
+        with tracing.span("shard.reconcile") as sp:
+            sp.annotate(residual_pods=plan.residual_pods)
+            if len(plan.residual_classes):
+                sub = _subproblem(
+                    problem, plan.residual_classes,
+                    problem.class_counts[plan.residual_classes].astype(
+                        np.int64),
+                    np.zeros(C, np.int64))
+                r = solve_classpack(sub, max_nodes=max_nodes_per_shard,
+                                    decode=False, guide=None)
+                cost += r.total_price
+                oi = {id(o): j for j, o in enumerate(problem.options)}
+                for nd in r.nodes:
+                    nodes_per_option[oi[id(nd.option)]] += 1
+                unsched += len(r.unschedulable)
+        metrics.shard_solve_duration().observe(time.perf_counter() - t2,
+                                               {"phase": "reconcile"})
+        return cost, nodes_per_option, unsched
+
+    # ---- decode path ----
+    compat_packed = np.packbits(compat_sh, axis=2)
+    P_shard = int(counts_sh.sum(axis=(1,)).max()) if n else 0
+    Ppad = pad_to(max(P_shard, 1))
+    shape = mesh.devices.shape
+    assign_fn = (_partitioned_assign if jax.default_backend() == "cpu"
+                 else _partitioned_assign_donate)
+    with tracing.span("shard.solve") as sp:
+        sp.annotate(shards=n, classes_per_shard=Cs, slots=K, pods=Ppad)
+        out = assign_fn(
+            jnp.asarray(requests_sh.reshape(*shape, Cpad, R)),
+            jnp.asarray(counts_sh.reshape(*shape, Cpad)),
+            jnp.asarray(compat_packed.reshape(*shape,
+                                              *compat_packed.shape[1:])),
+            jnp.asarray(node_cap_sh.reshape(*shape, Cpad)),
+            jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank),
+            jnp.asarray(init_opt.reshape(*shape, K)),
+            jnp.asarray(init_used.reshape(*shape, K, R)),
+            K, Ppad, mesh)
+        assignment, slot_option, _unsched = jax.device_get(out)
+    assignment = np.asarray(assignment).reshape(n, Ppad).astype(np.int32)
+    slot_option = np.asarray(slot_option).reshape(n, K)
+
+    # host decode: per-shard pod ids from whole-class membership (a class
+    # lives entirely on its shard), then the shared assembly
+    from ..ops.ffd import PackingResult
+    members_arr = problem.members_arrays()
+    pod_parts, cls_parts, slot_parts = [], [], []
+    for s in range(n):
+        P_s = int(counts_sh[s].sum())
+        if P_s == 0:
+            continue
+        chunks, cls_ids = [], []
+        for pos, ci in enumerate(shard_cls[s]):
+            k = int(counts_sh[s, pos])
+            if k == 0:
+                continue
+            chunks.append(members_arr[ci][:k])
+            cls_ids.append(np.full(k, ci, np.int64))
+        pod_s = np.concatenate(chunks)
+        a_s = assignment[s, :P_s]
+        slot_parts.append(
+            np.where(a_s >= 0, a_s.astype(np.int64) + s * K, -1))
+        pod_parts.append(pod_s)
+        cls_parts.append(np.concatenate(cls_ids))
+    if pod_parts:
+        result, used_add = _assemble_plan(
+            problem, np.concatenate(pod_parts), np.concatenate(cls_parts),
+            np.concatenate(slot_parts), slot_option, O, K)
+    else:
+        result, used_add = PackingResult(
+            nodes=[], unschedulable=[], existing_assignments={},
+            total_price=0.0), {}
+    metrics.shard_solve_duration().observe(time.perf_counter() - t1,
+                                           {"phase": "solve"})
+
+    # ---- host-side reconciliation of the straddling residual ----
+    t2 = time.perf_counter()
+    with tracing.span("shard.reconcile") as sp:
+        sp.annotate(residual_pods=plan.residual_pods)
+        if len(plan.residual_classes):
+            sub = _subproblem(
+                problem, plan.residual_classes,
+                problem.class_counts[plan.residual_classes].astype(np.int64),
+                np.zeros(C, np.int64))
+            if E:
+                # true leftovers: the mesh pass's fills are charged
+                # against each node's free space before the residual sees it
+                used2 = (existing_used.astype(np.float64).copy()
+                         if existing_used is not None
+                         else np.zeros((E, R), np.float64))
+                for eid in sorted(used_add):
+                    used2[eid] += used_add[eid]
+                r = solve_classpack(sub, max_nodes=max_nodes_per_shard,
+                                    existing_alloc=existing_alloc,
+                                    existing_used=used2,
+                                    existing_compat=ec[
+                                        plan.residual_classes],
+                                    guide=None)
+            else:
+                r = solve_classpack(sub, max_nodes=max_nodes_per_shard,
+                                    guide=None)
+            result.nodes.extend(r.nodes)
+            result.existing_assignments.update(r.existing_assignments)
+            result.unschedulable = sorted(
+                set(result.unschedulable) | set(r.unschedulable))
+            result.total_price += r.total_price
+    metrics.shard_solve_duration().observe(time.perf_counter() - t2,
+                                           {"phase": "reconcile"})
+    return result
+
+
+def maybe_solve_partitioned(problem: Problem, *, path: str,
+                            max_nodes: int = 4096,
+                            existing_alloc: Optional[np.ndarray] = None,
+                            existing_used: Optional[np.ndarray] = None,
+                            existing_compat: Optional[np.ndarray] = None,
+                            node_list: Optional[Sequence] = None):
+    """Controller entry: route a solve through the partitioned mesh when
+    the ShardedSolve gate is on AND the batch/mesh justify it.  Returns
+    None (with an outcome metric) whenever the caller should run its
+    normal single-device path — the gate must never change WHETHER a
+    batch solves, only WHERE."""
+    total = int(problem.class_counts.sum())
+    if total < MIN_PODS_DEFAULT or len(jax.devices()) < 2:
+        metrics.shard_solves().inc({"path": path, "outcome": "skipped"})
+        return None
+    existing_zone = None
+    if node_list:
+        zid = {z: i for i, z in enumerate(problem.zones)}
+        existing_zone = np.asarray(
+            [zid.get(getattr(nd, "zone", None), -1) for nd in node_list],
+            np.int64)
+    try:
+        res = solve_partitioned(problem, max_nodes_per_shard=max_nodes,
+                                decode=True,
+                                existing_alloc=existing_alloc,
+                                existing_used=existing_used,
+                                existing_compat=existing_compat,
+                                existing_zone=existing_zone)
+    except Exception:
+        log.exception("partitioned solve failed; falling back to the "
+                      "single-device path")
+        metrics.shard_solves().inc({"path": path, "outcome": "error"})
+        return None
+    metrics.shard_solves().inc(
+        {"path": path,
+         "outcome": "sharded" if res is not None else "fallback"})
+    return res

@@ -356,6 +356,19 @@ def _decode_sharded(problem, order, counts_flat, assignment, slot_option,
     pod_all = np.concatenate(pod_parts)
     cls_all = np.concatenate(cls_parts)
     slot_all = np.concatenate(slot_parts)
+    result, _ = _assemble_plan(problem, pod_all, cls_all, slot_all,
+                               slot_option, O, K)
+    return result
+
+
+def _assemble_plan(problem, pod_all, cls_all, slot_all, slot_option, O, K):
+    """Shared host assembly for every mesh decode path: node runs from
+    globally-offset slot ids, existing-vs-new column split, alternatives
+    memo, pod-hosting-only cost.  Also returns the per-existing-node
+    usage the fills added (float, problem scale) so the partitioned
+    driver's residual reconciliation can solve against true leftovers."""
+    from ..ops.classpack import resolve_alternatives
+    from ..ops.ffd import NodeDecision, PackingResult
 
     unschedulable = pod_all[slot_all < 0].tolist()
     sched = slot_all >= 0
@@ -371,12 +384,14 @@ def _decode_sharded(problem, order, counts_flat, assignment, slot_option,
 
     # existing vs new: columns ≥ O are existing-node fills
     existing_assignments = {}
+    existing_used_add = {}
     nodes = []
     new_idx = []
     jcb_list = []
     used_rows = []
     compat_bits = np.packbits(problem.class_compat, axis=1)
     reqs = problem.class_requests.astype(np.int64)
+    reqs_f = problem.class_requests
     pods_l = pod_all.tolist()
     for i in range(len(node_slots)):
         s, e = starts[i], ends[i]
@@ -385,6 +400,8 @@ def _decode_sharded(problem, order, counts_flat, assignment, slot_option,
             eid = int(col - O)
             for p in pods_l[s:e]:
                 existing_assignments[p] = eid
+            add = reqs_f[cls_all[s:e]].sum(axis=0)
+            existing_used_add[eid] = existing_used_add.get(eid, 0.0) + add
             continue
         cl = np.unique(cls_all[s:e])
         jcb_list.append(compat_bits[cl[0]] if len(cl) == 1 else
@@ -405,4 +422,4 @@ def _decode_sharded(problem, order, counts_flat, assignment, slot_option,
         total += float(problem.option_price[oi_l[j]])
     return PackingResult(nodes=nodes, unschedulable=unschedulable,
                          existing_assignments=existing_assignments,
-                         total_price=total)
+                         total_price=total), existing_used_add

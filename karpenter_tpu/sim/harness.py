@@ -100,13 +100,16 @@ class SimHarness:
     def __init__(self, scenario: Scenario, seed: int = 0,
                  duration_s: Optional[float] = None,
                  forecast: Optional[bool] = None,
-                 incremental_arena: Optional[bool] = None):
+                 incremental_arena: Optional[bool] = None,
+                 sharded_solve: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
         twice — knobs still come from the scenario's forecast block.
         `incremental_arena` likewise overrides the IncrementalArena gate
         (default on): False replays the exact pre-arena full-rebuild code
-        paths, the golden byte-identity escape hatch."""
+        paths, the golden byte-identity escape hatch.  `sharded_solve`
+        overrides the ShardedSolve gate (default off): goldens are recorded
+        with the gate off, so the default replay stays byte-identical."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -129,6 +132,8 @@ class SimHarness:
                        batch_max_duration=scenario.batch_max_s)
         if incremental_arena is not None:
             opts.feature_gates["IncrementalArena"] = bool(incremental_arena)
+        if sharded_solve is not None:
+            opts.feature_gates["ShardedSolve"] = bool(sharded_solve)
         fc = scenario.forecast
         fc_on = forecast if forecast is not None \
             else (fc is not None and fc.enabled)

@@ -479,6 +479,61 @@ def arena_gather() -> Counter:
         labels=("outcome",))
 
 
+def shard_solves() -> Counter:
+    """Partitioned-solve routing: `sharded` (the mesh ran the solve),
+    `fallback` (the planner refused — one compatibility group, or the
+    straddling residual exceeded the budget — and the single-device path
+    ran), `skipped` (gate on but the batch was too small or the mesh has
+    one device)."""
+    return REGISTRY.counter(
+        "karpenter_shard_solves_total",
+        "Sharded-solve attempts by caller path and outcome.",
+        labels=("path", "outcome"))
+
+
+def shard_count() -> Gauge:
+    """Shards the last partitioned solve ran across (mesh device count)."""
+    return REGISTRY.gauge(
+        "karpenter_shard_count",
+        "Device shards used by the last partitioned solve.")
+
+
+def shard_imbalance() -> Gauge:
+    """Partition balance: heaviest shard's pod count over the mean — the
+    scan is lockstep, so wall clock is the heaviest shard and this ratio
+    IS the parallel-efficiency ceiling."""
+    return REGISTRY.gauge(
+        "karpenter_shard_imbalance_ratio",
+        "Max-over-mean per-shard pod load of the last partition plan.")
+
+
+def shard_residual_pods() -> Gauge:
+    """Pods whose requirements straddle partitions (re-solved host-side
+    after the mesh pass). Large values mean the zone/nodepool structure
+    the planner exploits is absent and sharding buys little."""
+    return REGISTRY.gauge(
+        "karpenter_shard_reconcile_residual_pods",
+        "Pods re-solved by host reconciliation after the sharded pass.")
+
+
+def shard_residual_ratio() -> Gauge:
+    """Straddling residual as a fraction of the batch (the megafleet
+    acceptance bound is <0.01)."""
+    return REGISTRY.gauge(
+        "karpenter_shard_reconcile_residual_ratio",
+        "Residual pods over total pods in the last partitioned solve.")
+
+
+def shard_solve_duration() -> Histogram:
+    """Partitioned-solve phase latency: `partition` (host planner),
+    `solve` (mesh kernel + decode), `reconcile` (residual re-solve)."""
+    return REGISTRY.histogram(
+        "karpenter_shard_solve_duration_seconds",
+        "Partitioned-solve phase duration.",
+        labels=("phase",),
+        buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5, 15))
+
+
 def trace_span_duration() -> Histogram:
     """Duration of every completed tracing span (utils/tracing.py), labeled
     by span name — the histogram the /debug/traces timeline feeds so

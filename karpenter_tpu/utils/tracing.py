@@ -53,6 +53,8 @@ SPAN_NAMES = frozenset({
     "sweep.arena", "sweep.prefix", "sweep.decode", "sweep.single",
     # persistent cluster arena (ops/arena.py)
     "arena.rebuild", "arena.compact",
+    # fleet-scale partitioned solve (parallel/partition.py + driver.py)
+    "shard.partition", "shard.solve", "shard.reconcile",
     # refinery + LP guide
     "refinery.refine", "refinery.lp", "refinery.price",
     # forecast/headroom reconcile

@@ -405,6 +405,26 @@ class TestControllerManager:
         op2.options.feature_gates["LPGuide"] = False
         assert build_controllers(op2)["provisioning"].lp_guide is False
 
+    def test_sharded_solve_gate_plumbs_to_controllers(self):
+        """ShardedSolve is off by default and reaches both solve paths;
+        --sharded-solve is the CLI shorthand."""
+        clock = [100.0]
+        op = self._operator(clock)
+        ctrls = build_controllers(op)
+        assert ctrls["provisioning"].sharded_solve is False
+        assert ctrls["disruption"].sharded_solve is False
+        from karpenter_tpu.operator.options import Options
+        opts = Options.from_args(["--cluster-name", "t", "--sharded-solve"])
+        assert opts.feature_gates["ShardedSolve"] is True
+        opts2 = Options.from_args(["--cluster-name", "t", "--feature-gates",
+                                   "ShardedSolve=true"])
+        assert opts2.feature_gates["ShardedSolve"] is True
+        op2 = self._operator(clock)
+        op2.options.feature_gates["ShardedSolve"] = True
+        ctrls2 = build_controllers(op2)
+        assert ctrls2["provisioning"].sharded_solve is True
+        assert ctrls2["disruption"].sharded_solve is True
+
     def test_leader_election_gates_ticks(self, tmp_path):
         clock = [100.0]
         lease = str(tmp_path / "lease.json")
@@ -706,6 +726,44 @@ def test_apply_legacy_machine_registers_nodeclaim():
         op.apply({"apiVersion": "karpenter.tpu/v1alpha5", "kind": "Machine",
                   "metadata": {"name": "bad"},
                   "spec": {"requirements": [{"operator": "In"}]}})
+
+
+def test_apply_batch_matches_sequential_apply():
+    """apply() and apply_batch() share one registration path
+    (Operator._register): the same manifests must leave identical live
+    state either way — including the NodeClaim live-instance promotion —
+    and a phase-1 admission failure must leave NOTHING applied (the
+    divergence regression: a batch-only registration copy once skipped
+    promotion and admitted half a failing batch)."""
+    manifests = [
+        {"apiVersion": "karpenter.tpu/v1", "kind": "NodePool",
+         "metadata": {"name": "pool-a"},
+         "spec": {"template": {"spec": {"nodeClassRef": {"name": "default"}}}}},
+        {"apiVersion": "karpenter.tpu/v1alpha5", "kind": "Machine",
+         "metadata": {"name": "machine-b",
+                      "labels": {"karpenter.sh/provisioner-name": "pool-a"}},
+         "spec": {"machineTemplateRef": {"name": "default"}},
+         "status": {"providerID": "i-mb", "instanceType": "a.small"}},
+    ]
+    seq = Operator(Options(), catalog=generate_catalog(10))
+    for m in manifests:
+        seq.apply(m)
+    bat = Operator(Options(), catalog=generate_catalog(10))
+    bat.apply_batch(manifests)
+    assert set(bat.nodepools) == set(seq.nodepools) == {"default", "pool-a"}
+    assert set(bat.cluster.nodeclaims) == set(seq.cluster.nodeclaims)
+    for op in (seq, bat):
+        node = op.cluster.node_for_provider_id("i-mb")
+        assert node is not None, "batch path skipped live-claim promotion"
+    assert (bat.cluster.node_for_provider_id("i-mb").allocatable
+            == seq.cluster.node_for_provider_id("i-mb").allocatable)
+    # atomicity: a bad manifest ANYWHERE in the batch applies nothing
+    import pytest as _pytest
+    atomic = Operator(Options(), catalog=generate_catalog(10))
+    with _pytest.raises(ValueError):
+        atomic.apply_batch(manifests + [{"kind": "Nope", "metadata": {}}])
+    assert "pool-a" not in atomic.nodepools
+    assert not atomic.cluster.nodeclaims
 
 
 class TestDebugEndpoints:

@@ -1,0 +1,146 @@
+"""Partition planner: compatibility-group discovery, LPT balance,
+determinism, and the fallback contract (None whenever the structure the
+decomposition needs is absent)."""
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool
+from karpenter_tpu.ops import tensorize
+from karpenter_tpu.parallel import plan_partition
+
+ZONES = tuple(f"zone-{c}" for c in "abcdefgh")
+
+
+def zoned_catalog(zones=ZONES):
+    return [make_type("a.small", 2, 4, 0.10, zones=zones),
+            make_type("a.large", 8, 16, 0.40, zones=zones)]
+
+
+def pinned_pods(per_zone=40, zones=ZONES, cpu_m=500):
+    return [cpu_pod(cpu_m=cpu_m, mem_mib=256, node_selector={wk.ZONE: z})
+            for z in zones for _ in range(per_zone)]
+
+
+def test_pinned_classes_partition_by_zone():
+    prob = tensorize(pinned_pods(), zoned_catalog(), [NodePool()])
+    plan = plan_partition(prob, 8, min_pods=1)
+    assert plan is not None
+    assert plan.n_shards == 8
+    assert plan.residual_pods == 0
+    assert len(plan.residual_classes) == 0
+    # every class is assigned, every option too
+    assert (plan.class_shard >= 0).all()
+    assert (plan.option_shard >= 0).all()
+    # a class and every option it is compatible with share a shard:
+    # bins never span shards
+    for ci in range(prob.num_classes):
+        opts = np.nonzero(prob.class_compat[ci])[0]
+        assert (plan.option_shard[opts] == plan.class_shard[ci]).all()
+
+
+def test_lpt_balance_and_imbalance_metric():
+    prob = tensorize(pinned_pods(per_zone=64), zoned_catalog(), [NodePool()])
+    plan = plan_partition(prob, 8, min_pods=1)
+    # 8 equal zone groups over 8 shards: perfectly balanced
+    assert plan.imbalance == pytest.approx(1.0)
+    assert plan.shard_pods.sum() == plan.total_pods - plan.residual_pods
+    plan4 = plan_partition(prob, 4, min_pods=1)
+    # 8 equal groups over 4 shards: LPT stacks 2 each
+    assert plan4.imbalance == pytest.approx(1.0)
+    assert len(set(plan4.class_shard.tolist())) == 4
+
+
+def test_deterministic_across_calls():
+    prob = tensorize(pinned_pods(per_zone=17), zoned_catalog(), [NodePool()])
+    a = plan_partition(prob, 4, min_pods=1)
+    b = plan_partition(prob, 4, min_pods=1)
+    assert (a.class_shard == b.class_shard).all()
+    assert (a.option_shard == b.option_shard).all()
+    assert a.imbalance == b.imbalance
+
+
+def test_free_pods_become_residual():
+    pods = pinned_pods(per_zone=30) + [cpu_pod(cpu_m=300, mem_mib=128)
+                                       for _ in range(9)]
+    prob = tensorize(pods, zoned_catalog(), [NodePool()])
+    plan = plan_partition(prob, 8, min_pods=1)
+    assert plan is not None
+    assert plan.residual_pods == 9
+    assert (plan.class_shard[plan.residual_classes] == -1).all()
+    # residual classes are exactly the free ones (compat spans all zones)
+    for ci in plan.residual_classes:
+        assert prob.class_compat[ci].all()
+
+
+def test_two_zone_classes_merge_groups():
+    """A class compatible with exactly two zones (ntouch==2) merges them:
+    the class is assigned, not residual, and both zones' options land on
+    its shard."""
+    zones = ("zone-a", "zone-b", "zone-c", "zone-d")
+    cat = zoned_catalog(zones)
+    pods = pinned_pods(per_zone=20, zones=zones)
+    # pods spanning exactly zones a+b via a 2-zone affinity requirement
+    from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+    bridge = [cpu_pod(cpu_m=400, mem_mib=256,
+                      required_affinity_terms=[Requirements.of(
+                          Requirement(wk.ZONE, IN, ["zone-a", "zone-b"]))])
+              for _ in range(10)]
+    prob = tensorize(pods + bridge, cat, [NodePool()])
+    plan = plan_partition(prob, 4, min_pods=1)
+    assert plan is not None
+    assert plan.residual_pods == 0
+    bci = [ci for ci in range(prob.num_classes)
+           if 0 < prob.class_compat[ci].sum() < prob.num_options
+           and len({prob.option_zone[o]
+                    for o in np.nonzero(prob.class_compat[ci])[0]}) == 2]
+    assert bci, "no 2-zone bridge class tensorized"
+    for ci in bci:
+        opts = np.nonzero(prob.class_compat[ci])[0]
+        assert (plan.option_shard[opts] == plan.class_shard[ci]).all()
+
+
+def test_refuses_without_structure():
+    # single zone → one group → nothing to split
+    one = tensorize(pinned_pods(per_zone=50, zones=("zone-a",)),
+                    zoned_catalog(("zone-a",)), [NodePool()])
+    assert plan_partition(one, 8, min_pods=1) is None
+    # below the pod floor
+    few = tensorize(pinned_pods(per_zone=2), zoned_catalog(), [NodePool()])
+    assert plan_partition(few, 8, min_pods=512) is None
+    # n_shards < 2 is never a partition
+    prob = tensorize(pinned_pods(), zoned_catalog(), [NodePool()])
+    assert plan_partition(prob, 1, min_pods=1) is None
+
+
+def test_refuses_on_residual_blowup():
+    """Mostly-free pods: the residual fraction cap refuses the plan
+    rather than shipping a mesh pass that solves almost nothing."""
+    pods = ([cpu_pod(cpu_m=300, mem_mib=128) for _ in range(100)]
+            + pinned_pods(per_zone=5))
+    prob = tensorize(pods, zoned_catalog(), [NodePool()])
+    assert plan_partition(prob, 8, min_pods=1,
+                          max_residual_frac=0.2) is None
+
+
+def test_existing_nodes_join_their_zone_group():
+    """Existing nodes enter the incidence: a node pinned to zone-b must
+    land on the same shard as zone-b's classes/options."""
+    prob = tensorize(pinned_pods(per_zone=25), zoned_catalog(), [NodePool()])
+    Z = len(prob.zones)
+    E = 8
+    ex_zone = np.arange(E, dtype=np.int64) % Z
+    # zone-consistent compat: class c may use node e iff they share a zone
+    zone_1hot = np.zeros((prob.num_options, Z), bool)
+    zone_1hot[np.arange(prob.num_options), prob.option_zone] = True
+    cls_zone = (prob.class_compat @ zone_1hot) > 0
+    ec = cls_zone[:, ex_zone]
+    plan = plan_partition(prob, 8, existing_compat=ec, existing_zone=ex_zone,
+                          min_pods=1)
+    assert plan is not None
+    assert (plan.existing_shard >= 0).all()
+    for e in range(E):
+        cls_e = np.nonzero(ec[:, e])[0]
+        assert (plan.class_shard[cls_e] == plan.existing_shard[e]).all()

@@ -1,0 +1,201 @@
+"""Partitioned mesh driver: plan parity with the single-device solver
+(the decomposition's correctness standard), straddling-pod residual
+reconciliation, existing-node ownership, and the controller gate.
+
+Parity is compared on canonicalized PLANS — exact (option, pod-set)
+equality — while total_price gets a tolerance: float32 summation order
+differs between the psum tree and the sequential scan (~1e-6 relative),
+but the launch decisions must not."""
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodePool
+from karpenter_tpu.ops import solve_classpack, tensorize
+from karpenter_tpu.parallel import make_pod_mesh, solve_partitioned
+
+ZONES = tuple(f"zone-{c}" for c in "abcdefgh")
+
+
+def zoned_catalog(zones=ZONES):
+    return [make_type("a.small", 2, 4, 0.10, zones=zones),
+            make_type("a.medium", 4, 8, 0.20, zones=zones),
+            make_type("a.large", 8, 16, 0.40, zones=zones)]
+
+
+def random_pinned_pods(rng, zones=ZONES, n_specs=12, total=640):
+    """Zone-pinned pods with random shapes: every class touches exactly
+    one zone group, so the input is fully shardable."""
+    specs = [(int(rng.integers(100, 4000)), int(rng.integers(128, 8192)))
+             for _ in range(n_specs)]
+    pods = []
+    for i in range(total):
+        cpu, mem = specs[int(rng.integers(0, n_specs))]
+        pods.append(cpu_pod(cpu_m=cpu, mem_mib=mem,
+                            node_selector={wk.ZONE:
+                                           zones[int(rng.integers(0, len(zones)))]}))
+    return pods
+
+
+def canon(prob, res):
+    """Canonical plan: sorted (option index, sorted pod tuple) for new
+    nodes, sorted existing fills, sorted unschedulable."""
+    oi = {id(o): j for j, o in enumerate(prob.options)}
+    new = sorted((oi[id(nd.option)], tuple(sorted(nd.pod_indices)))
+                 for nd in res.nodes)
+    return (new, sorted(res.existing_assignments.items()),
+            sorted(res.unschedulable))
+
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_plan_parity_randomized(n_dev, seed):
+    """The decisive property: on shardable inputs the partitioned plan
+    EQUALS the single-device plan — same nodes, same pod placement —
+    at every mesh width."""
+    rng = np.random.default_rng(seed)
+    prob = tensorize(random_pinned_pods(rng), zoned_catalog(), [NodePool()])
+    single = solve_classpack(prob, guide=None)
+    part = solve_partitioned(prob, mesh=make_pod_mesh(n_dev),
+                             max_nodes_per_shard=512, min_pods=1)
+    assert part is not None, "planner refused a fully-shardable input"
+    assert canon(prob, part) == canon(prob, single)
+    assert part.total_price == pytest.approx(single.total_price, rel=1e-5)
+
+
+def test_straddling_pods_reconciled():
+    """Zone-free pods straddle every partition: the mesh pass skips
+    them, the host residual solve places them, and the merged plan
+    covers every pod exactly once."""
+    rng = np.random.default_rng(3)
+    pods = random_pinned_pods(rng, total=480)
+    free = [cpu_pod(cpu_m=700, mem_mib=512) for _ in range(24)]
+    prob = tensorize(pods + free, zoned_catalog(), [NodePool()])
+    res = solve_partitioned(prob, mesh=make_pod_mesh(8),
+                            max_nodes_per_shard=512, min_pods=1)
+    assert res is not None
+    assert not res.unschedulable
+    placed = [p for nd in res.nodes for p in nd.pod_indices]
+    placed += list(res.existing_assignments)
+    assert sorted(placed) == list(range(len(pods) + len(free)))
+    # every free pod landed somewhere real, on a compatible option
+    oi = {id(o): j for j, o in enumerate(prob.options)}
+    cls_of = np.empty(len(prob.pods), np.int64)
+    for ci, mem in enumerate(prob.class_members):
+        cls_of[np.asarray(mem, np.int64)] = ci
+    for nd in res.nodes:
+        col = oi[id(nd.option)]
+        for p in nd.pod_indices:
+            assert prob.class_compat[cls_of[p], col]
+
+
+def test_existing_nodes_owned_and_parity():
+    """Existing capacity rides the mesh shard that owns it; fills and
+    tucks match the single-device solve exactly, and no node is
+    over-committed."""
+    rng = np.random.default_rng(4)
+    prob = tensorize(random_pinned_pods(rng, total=560), zoned_catalog(),
+                     [NodePool()])
+    Z = len(prob.zones)
+    E = 16
+    ex_zone = (np.arange(E, dtype=np.int64) % Z)
+    big = prob.option_alloc.max(axis=0) * 2
+    ex_alloc = np.tile(big, (E, 1)).astype(np.float32)
+    ex_used = np.zeros_like(ex_alloc)
+    zone_1hot = np.zeros((prob.num_options, Z), bool)
+    zone_1hot[np.arange(prob.num_options), prob.option_zone] = True
+    ec = ((prob.class_compat @ zone_1hot) > 0)[:, ex_zone]
+    single = solve_classpack(prob, guide=None, existing_alloc=ex_alloc,
+                             existing_used=ex_used, existing_compat=ec)
+    part = solve_partitioned(prob, mesh=make_pod_mesh(8),
+                             max_nodes_per_shard=512, min_pods=1,
+                             existing_alloc=ex_alloc, existing_used=ex_used,
+                             existing_compat=ec, existing_zone=ex_zone)
+    assert part is not None
+    assert len(part.existing_assignments) > 0, "existing columns unused"
+    assert canon(prob, part) == canon(prob, single)
+    # capacity audit on the fills
+    cls_of = np.empty(len(prob.pods), np.int64)
+    for ci, mem in enumerate(prob.class_members):
+        cls_of[np.asarray(mem, np.int64)] = ci
+    fill = np.zeros((E, len(prob.axes)), np.float64)
+    for p, e in part.existing_assignments.items():
+        assert ec[cls_of[p], e]
+        fill[e] += prob.class_requests[cls_of[p]]
+    assert (fill <= ex_alloc - ex_used + 1e-6).all()
+
+
+def test_unshardable_falls_back_to_none():
+    # one zone: no structure, the caller must take the single-device path
+    pods = [cpu_pod(cpu_m=500, mem_mib=256,
+                    node_selector={wk.ZONE: "zone-a"}) for _ in range(64)]
+    prob = tensorize(pods, zoned_catalog(("zone-a",)), [NodePool()])
+    assert solve_partitioned(prob, mesh=make_pod_mesh(8),
+                             max_nodes_per_shard=64, min_pods=1) is None
+
+
+def test_aggregate_matches_decode_fleet():
+    """decode=False (the feasibility/bench reduction) reports the same
+    fleet the decode path builds."""
+    rng = np.random.default_rng(5)
+    prob = tensorize(random_pinned_pods(rng, total=512), zoned_catalog(),
+                     [NodePool()])
+    mesh = make_pod_mesh(8)
+    res = solve_partitioned(prob, mesh=mesh, max_nodes_per_shard=512,
+                            min_pods=1)
+    cost, npo, unsched = solve_partitioned(prob, mesh=mesh,
+                                           max_nodes_per_shard=512,
+                                           min_pods=1, decode=False)
+    oi = {id(o): j for j, o in enumerate(prob.options)}
+    dec = np.zeros(prob.num_options, np.int64)
+    for nd in res.nodes:
+        dec[oi[id(nd.option)]] += 1
+    assert (npo == dec).all()
+    assert unsched == len(res.unschedulable) == 0
+    assert cost == pytest.approx(res.total_price, rel=1e-5)
+
+
+def test_provisioner_gate_parity():
+    """The ShardedSolve gate through the real Provisioner: identical
+    launch decisions with the gate on and off."""
+    from karpenter_tpu.cloud import CloudProvider, FakeCloud
+    from karpenter_tpu.controllers import Provisioner
+    from karpenter_tpu.state import Cluster
+
+    def launch_plan(sharded):
+        cloud = FakeCloud()
+        provider = CloudProvider(cloud, zoned_catalog())
+        cluster = Cluster()
+        rng = np.random.default_rng(6)
+        for p in random_pinned_pods(rng, total=600):
+            cluster.add_pod(p)
+        # lp_guide off: the parity contract is vs the greedy single-device
+        # scan (the sharded driver's per-shard kernel); the guided path
+        # legitimately builds a different (cheaper-mix) plan
+        prov = Provisioner(provider, cluster, [NodePool()],
+                           lp_guide=False, sharded_solve=sharded)
+        problem, result = prov.solve(cluster.pending_pods())
+        oi = {id(o): j for j, o in enumerate(problem.options)}
+        return sorted((nd.option.instance_type, nd.option.zone,
+                       tuple(sorted(nd.pod_indices)))
+                      for nd in result.nodes), sorted(result.unschedulable)
+
+    assert launch_plan(True) == launch_plan(False)
+
+
+def test_gate_metrics_outcomes():
+    """maybe_solve_partitioned records where each batch went."""
+    from karpenter_tpu.parallel.driver import maybe_solve_partitioned
+    from karpenter_tpu.utils import metrics as m
+
+    before = m.shard_solves().value({"path": "provisioning",
+                                     "outcome": "skipped"})
+    # tiny batch: under the floor → skipped
+    prob = tensorize([cpu_pod() for _ in range(4)], zoned_catalog(),
+                     [NodePool()])
+    assert maybe_solve_partitioned(prob, path="provisioning") is None
+    after = m.shard_solves().value({"path": "provisioning",
+                                    "outcome": "skipped"})
+    assert after == before + 1

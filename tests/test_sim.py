@@ -280,6 +280,26 @@ def test_golden_report_arena_gate_off(name, fname, duration):
             f"changed behavior, not just latency")
 
 
+@pytest.mark.parametrize("gate", [False, True], ids=["off", "on"])
+def test_golden_report_sharded_solve_gate(gate):
+    """The ShardedSolve gate must never change WHAT a cluster does, only
+    where fleet-scale batches solve.  Goldens are recorded with the gate
+    off (the default); an explicit off-override must be byte-identical,
+    and the gate ON must be too — every sim batch sits under the
+    partitioned driver's pod floor, so each one records a `skipped`
+    outcome and solves on the exact single-device path."""
+    name, fname, duration = GOLDEN_CASES[0]  # diurnal
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     sharded_solve=gate).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"sharded_solve={gate} report for {fname} diverged from "
+            f"{path}: the gate changed behavior, not just placement")
+
+
 # ---------------------------------------------------------------------------
 # sim-vs-live parity smoke
 # ---------------------------------------------------------------------------
