@@ -167,9 +167,10 @@ def default_checkers() -> List[Checker]:
     from .jaxhot import JaxHotPathChecker
     from .locks import LockDisciplineChecker
     from .observability import ObservabilityChecker
+    from .robustness import RobustnessChecker
     return [JaxHotPathChecker(), DeterminismChecker(),
             LockDisciplineChecker(), ObservabilityChecker(),
-            ArenaDisciplineChecker()]
+            ArenaDisciplineChecker(), RobustnessChecker()]
 
 
 def run_analysis(root: str,

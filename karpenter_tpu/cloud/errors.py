@@ -53,6 +53,22 @@ LAUNCH_TEMPLATE_NOT_FOUND_CODES = frozenset({
     "InvalidLaunchTemplateName.NotFoundException",
 })
 
+# Transient faults worth an in-call retry: throttles and provider-side
+# internal errors (the aws-sdk retryer's default retryable set).  NOT
+# unfulfillable capacity — that is a *state*, fed to the ICE cache, and
+# re-asking the same offering inside one call can't change it.
+RETRYABLE_CODES = frozenset({
+    "RequestLimitExceeded",
+    "Throttling",
+    "ThrottlingException",
+    "RequestThrottled",
+    "TooManyRequestsException",
+    "InternalError",
+    "InternalFailure",
+    "ServiceUnavailable",
+    "Unavailable",
+})
+
 
 def _code(err: Optional[BaseException]) -> str:
     return getattr(err, "code", "") or ""
@@ -86,6 +102,13 @@ def is_launch_template_not_found(err: Optional[BaseException]) -> bool:
     return _code(err) in LAUNCH_TEMPLATE_NOT_FOUND_CODES
 
 
+def is_retryable(err: Optional[BaseException]) -> bool:
+    """IsRetryable: a transient throttle/internal fault — safe to retry
+    the SAME request after a jittered backoff (cloud/provider.py
+    RetryPolicy).  Unfulfillable capacity is deliberately excluded."""
+    return _code(err) in RETRYABLE_CODES
+
+
 def classify(err) -> str:
     """One-word classification for logs/metrics labels.  Duck-typed on the
     `code` attribute so fleet per-override errors (cloud/fake.py FleetError)
@@ -100,4 +123,6 @@ def classify(err) -> str:
         return "not_found"
     if is_already_exists(err):
         return "already_exists"
+    if is_retryable(err):
+        return "retryable"
     return "cloud_error"

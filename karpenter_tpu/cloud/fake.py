@@ -162,12 +162,17 @@ class FakeCloud:
     def _count(self, api: str):
         self.calls[api] = self.calls.get(api, 0) + 1
 
-    def _maybe_raise(self):
+    def _maybe_raise(self, api: str = ""):
         if self.next_error is not None:
             err, self.next_error = self.next_error, None
             raise err
         if self.clock() < self.throttle_until:
             raise CloudError("RequestLimitExceeded", "throttle window open")
+        # chaos seam: rules targeting point "cloud.api" key on the API name
+        # (utils/chaos.py); a no-op unless the injector is armed
+        from ..utils.chaos import CHAOS
+        if CHAOS.enabled:
+            CHAOS.inject("cloud.api", key=api)
 
     # ---- APIs ----
     def create_fleet(self, overrides: Sequence[FleetOverride], count: int = 1,
@@ -177,7 +182,7 @@ class FakeCloud:
         (/root/reference/pkg/providers/instance/instance.go:369-375,522-536)."""
         with self._lock:
             self._count("create_fleet")
-            self._maybe_raise()
+            self._maybe_raise("create_fleet")
             errors: List[FleetError] = []
             usable: List[FleetOverride] = []
             seen_ice: Set[Tuple[str, str, str]] = set()
@@ -209,7 +214,7 @@ class FakeCloud:
                            include_terminated: bool = False) -> List[CloudInstance]:
         with self._lock:
             self._count("describe_instances")
-            self._maybe_raise()
+            self._maybe_raise("describe_instances")
             out = []
             for inst in self._instances.values():
                 if ids is not None and inst.id not in ids:
@@ -232,7 +237,7 @@ class FakeCloud:
     def terminate_instances(self, ids: Sequence[str]) -> List[str]:
         with self._lock:
             self._count("terminate_instances")
-            self._maybe_raise()
+            self._maybe_raise("terminate_instances")
             done = []
             for iid in ids:
                 inst = self._instances.get(iid)
@@ -244,19 +249,19 @@ class FakeCloud:
     def describe_subnets(self) -> List["SubnetInfo"]:
         with self._lock:
             self._count("describe_subnets")
-            self._maybe_raise()
+            self._maybe_raise("describe_subnets")
             return list(self.subnets)
 
     def describe_security_groups(self) -> List["SecurityGroupInfo"]:
         with self._lock:
             self._count("describe_security_groups")
-            self._maybe_raise()
+            self._maybe_raise("describe_security_groups")
             return list(self.security_groups)
 
     def describe_images(self, ids: Optional[Sequence[str]] = None) -> List["ImageInfo"]:
         with self._lock:
             self._count("describe_images")
-            self._maybe_raise()
+            self._maybe_raise("describe_images")
             if ids is None:
                 return list(self.images)
             want = set(ids)
@@ -265,7 +270,7 @@ class FakeCloud:
     def create_launch_template(self, lt: "LaunchTemplateInfo") -> "LaunchTemplateInfo":
         with self._lock:
             self._count("create_launch_template")
-            self._maybe_raise()
+            self._maybe_raise("create_launch_template")
             if lt.name in self.launch_templates:
                 raise CloudError("InvalidLaunchTemplateName.AlreadyExistsException",
                                  lt.name)
@@ -276,7 +281,7 @@ class FakeCloud:
                                   ) -> List["LaunchTemplateInfo"]:
         with self._lock:
             self._count("describe_launch_templates")
-            self._maybe_raise()
+            self._maybe_raise("describe_launch_templates")
             out = []
             for lt in self.launch_templates.values():
                 if tag_filter and any(lt.tags.get(k) != v
@@ -288,7 +293,7 @@ class FakeCloud:
     def delete_launch_template(self, name: str) -> None:
         with self._lock:
             self._count("delete_launch_template")
-            self._maybe_raise()
+            self._maybe_raise("delete_launch_template")
             if name not in self.launch_templates:
                 raise CloudError("InvalidLaunchTemplateId.NotFound", name)
             del self.launch_templates[name]
@@ -298,13 +303,13 @@ class FakeCloud:
         (/root/reference/pkg/providers/pricing/pricing.go:308+)."""
         with self._lock:
             self._count("describe_spot_price_history")
-            self._maybe_raise()
+            self._maybe_raise("describe_spot_price_history")
             return dict(self.spot_prices)
 
     def create_tags(self, iid: str, tags: Dict[str, str]) -> None:
         with self._lock:
             self._count("create_tags")
-            self._maybe_raise()
+            self._maybe_raise("create_tags")
             inst = self._instances.get(iid)
             if inst is None:
                 raise CloudError("InstanceNotFound", iid)

@@ -17,6 +17,7 @@ import copy
 import json
 import logging
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +48,83 @@ class NodeClassNotFoundError(InsufficientCapacityError):
     configuration error, not a capacity shortage (reference NotFound class,
     errors.go:56-103).  Subclasses InsufficientCapacityError so the launch
     path's retry handling still applies, but callers can log it distinctly."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded in-call retry for RETRYABLE cloud faults (cloud/errors.py
+    is_retryable: throttles + provider internal errors).  `attempts` is
+    extra tries beyond the first call; 0 (the default) disables retry
+    entirely — the sim must NOT wall-sleep against its virtual clock, so
+    only live operators arm this via --cloud-retry-attempts.  Jitter is a
+    hash of (method, attempt), not an RNG, for deterministic tests."""
+    attempts: int = 0
+    base_s: float = 0.2
+    max_s: float = 5.0
+
+    def delay(self, method: str, attempt: int) -> float:
+        raw = min(self.max_s, self.base_s * 2.0 ** max(0, attempt - 1))
+        h = zlib.crc32(f"{method}:{attempt}".encode()) & 0xFFFFFFFF
+        return raw * (0.5 + (h / 2**32) * 0.5)
+
+
+class ProviderCircuitBreaker:
+    """Error-storm breaker over the whole provider: `threshold`
+    consecutive retryable-class failures OPEN the circuit and launches
+    fast-fail as InsufficientCapacityError for `cooldown_s` — feeding the
+    pending-pod/ICE backoff machinery instead of hot-looping CreateFleet
+    against a melting API.  After the cooldown one call probes half-open.
+    threshold=0 (default) disables the breaker."""
+
+    def __init__(self, threshold: int = 0, cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self.failures = 0
+        self.state = "closed"
+        self.open_until = float("-inf")
+        self.total_opens = 0
+
+    def allow(self) -> bool:
+        if self.threshold <= 0 or self.state == "closed":
+            return True
+        if self.clock() < self.open_until:
+            return False
+        self._set_state("half_open")  # one probe call through
+        return True
+
+    def record_success(self) -> None:
+        if self.threshold <= 0:
+            return
+        self.failures = 0
+        if self.state != "closed":
+            log.info("cloud circuit recovered (%s -> closed)", self.state)
+            self._set_state("closed")
+
+    def record_failure(self) -> None:
+        if self.threshold <= 0:
+            return
+        self.failures += 1
+        if self.state == "half_open" or self.failures >= self.threshold:
+            self.open_until = self.clock() + self.cooldown_s
+            if self.state != "open":
+                self.total_opens += 1
+                metrics.cloud_breaker_opens().inc()
+                log.warning("cloud circuit OPEN after %d consecutive "
+                            "failures; fast-failing launches for %.0fs",
+                            self.failures, self.cooldown_s)
+            self._set_state("open")
+
+    def _set_state(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            metrics.cloud_breaker_state().set(
+                {"closed": 0, "half_open": 1, "open": 2}[state])
+
+    def snapshot(self) -> Dict:
+        return {"state": self.state, "consecutive_failures": self.failures,
+                "total_opens": self.total_opens}
 
 
 @dataclass
@@ -186,8 +264,16 @@ class CloudProvider:
                  node_classes: Optional[Dict[str, NodeClass]] = None,
                  cluster_name: str = "default",
                  clock: Callable[[], float] = time.time,
-                 subnets=None, launch_templates=None, pricing=None):
+                 subnets=None, launch_templates=None, pricing=None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[ProviderCircuitBreaker] = None,
+                 sleep: Callable[[float], None] = time.sleep):
         self.cloud = cloud
+        # call hardening (both default OFF): bounded jittered retry for
+        # transient API faults, provider-level circuit breaker for storms
+        self.retry = retry
+        self.breaker = breaker
+        self.sleep = sleep
         self.unavailable = unavailable or UnavailableOfferings()
         self.instance_types = InstanceTypesProvider(catalog, self.unavailable,
                                                     pricing=pricing)
@@ -208,6 +294,41 @@ class CloudProvider:
         reqs = nodepool.requirements()
         return [it for it in its
                 if reqs.compatible(it.requirements, allow_undefined=[wk.NODEPOOL])]
+
+    def _call_cloud(self, method: str, fn: Callable):
+        """Run one cloud API call under the retry policy + breaker
+        bookkeeping.  Only RETRYABLE faults (throttles/internal errors)
+        are retried; everything else — and exhausted retries — propagates
+        to the caller's existing taxonomy handling."""
+        from .errors import is_retryable
+        budget = self.retry.attempts if self.retry is not None else 0
+        attempt = 0
+        while True:
+            try:
+                out = fn()
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                if attempt:
+                    metrics.cloud_retries().inc(
+                        {"method": method, "outcome": "recovered"})
+                return out
+            except CloudError as err:
+                if not is_retryable(err):
+                    raise
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                if attempt >= budget:
+                    if budget:
+                        metrics.cloud_retries().inc(
+                            {"method": method, "outcome": "exhausted"})
+                    raise
+                attempt += 1
+                metrics.cloud_retries().inc(
+                    {"method": method, "outcome": "retried"})
+                delay = self.retry.delay(method, attempt)
+                log.info("retrying %s after %s (attempt %d/%d, %.2fs)",
+                         method, err.code, attempt, budget, delay)
+                self.sleep(delay)
 
     # ---- actuation ----
     def create(self, claim: NodeClaim) -> NodeClaim:
@@ -231,6 +352,12 @@ class CloudProvider:
         /root/reference/pkg/providers/instance/instance.go:88-105)."""
         if not claim.created_at:
             claim.created_at = self.clock()
+        if self.breaker is not None and not self.breaker.allow():
+            # fast-fail into the same path an all-ICE'd launch takes: the
+            # claim fails, pending pods back off and re-solve later —
+            # instead of hammering CreateFleet through an error storm
+            raise InsufficientCapacityError(
+                "cloud circuit open: launches fast-fail during cooldown")
         nodeclass = self.node_classes.get(claim.node_class_ref)
         # capacity-fit validation must see the nodeclass's boot volume: a
         # mapped 200Gi root makes storage-heavy claims valid even though
@@ -338,7 +465,9 @@ class CloudProvider:
                 nodeclass.hash_annotation = static_hash(nodeclass)
             claim.node_class_hash = nodeclass.hash_annotation
             tags["karpenter.sh/nodeclass-hash"] = nodeclass.hash_annotation
-        result = self.cloud.create_fleet(overrides, count=1, tags=tags)
+        result = self._call_cloud(
+            "create_fleet",
+            lambda: self.cloud.create_fleet(overrides, count=1, tags=tags))
         # settle the in-flight IP predictions against where the launch landed
         # (subnet.go UpdateInflightIPs:149)
         if zonal_subnets is not None:
@@ -432,8 +561,10 @@ class CloudProvider:
         """All cluster-owned instances as NodeClaims (GC ground truth,
         /root/reference/pkg/controllers/nodeclaim/garbagecollection/controller.go:57-91)."""
         out = []
-        for inst in self.cloud.describe_instances(
-                tag_filter={"karpenter.sh/cluster": self.cluster_name}):
+        for inst in self._call_cloud(
+                "describe_instances",
+                lambda: self.cloud.describe_instances(
+                    tag_filter={"karpenter.sh/cluster": self.cluster_name})):
             out.append(self._instance_to_claim(inst))
         return out
 
@@ -552,4 +683,6 @@ class CloudProvider:
         return None
 
     def liveness_probe(self) -> bool:
-        return True
+        # an open breaker means the substrate is failing hard enough that
+        # we've stopped talking to it — surface that on /readyz
+        return self.breaker is None or self.breaker.state != "open"

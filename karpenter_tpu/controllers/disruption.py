@@ -45,12 +45,14 @@ from ..ops.classpack import solve_classpack
 from ..ops.constraints import (LEVEL_REQUIRED_ONLY,
                                find_batch_topology_violations, lower_pods,
                                make_zone_feasibility)
-from ..ops.ffd import PackingResult
+from ..ops.ffd import PackingResult, solve_ffd
 from ..ops.tensorize import Problem, tensorize
 from ..parallel.driver import maybe_solve_partitioned
 from ..state.cluster import Cluster
 from ..utils import metrics, tracing
+from ..utils.chaos import CHAOS
 from ..utils.events import Event
+from ..utils.watchdog import WatchdogTimeout, run_with_deadline
 
 log = logging.getLogger("karpenter_tpu.disruption")
 
@@ -181,7 +183,9 @@ class DisruptionController:
                  # False = the original sequential binary-search +
                  # per-candidate screen loop
                  batched_sweep: bool = True,
-                 sharded_solve: bool = False):
+                 sharded_solve: bool = False,
+                 health=None,
+                 watchdog_timeout_s: float = 0.0):
         from ..utils.events import Recorder
         self.provider = provider
         self.cluster = cluster
@@ -200,6 +204,10 @@ class DisruptionController:
         # (decode=False) stay on the aggregate kernel — they are already
         # cheap and batched.
         self.sharded_solve = sharded_solve
+        # shared degradation ladder (ops/health.py) + per-simulate hard
+        # deadline (utils/watchdog.py); None/0 keep the legacy direct path
+        self.health = health
+        self.watchdog_timeout_s = watchdog_timeout_s
         self._empty_since: Dict[str, float] = {}  # node → first seen empty
         self._arena_cache = None  # (fingerprint, SimulationArena)
         # (mutation_epoch, catalog_key, candidates, fingerprint) — skips the
@@ -360,25 +368,8 @@ class DisruptionController:
                 nodes=[], unschedulable=list(range(len(pods))),
                 existing_assignments={}, total_price=0.0)
             return problem, result, node_list
-        result = None
-        if decode and self.sharded_solve:
-            result = maybe_solve_partitioned(
-                problem, path="disruption", max_nodes=2048,
-                existing_alloc=alloc if len(node_list) else None,
-                existing_used=used if len(node_list) else None,
-                existing_compat=compat if len(node_list) else None,
-                node_list=node_list)
-        if result is None:
-            result = solve_classpack(
-                problem,
-                existing_alloc=alloc if len(node_list) else None,
-                existing_used=used if len(node_list) else None,
-                existing_compat=compat if len(node_list) else None,
-                decode=decode,
-                # the LPGuide gate covers THIS path too: a fresh replacement
-                # solve (all candidates excluded, no survivors) would
-                # otherwise run the guide despite the escape hatch
-                guide="lp" if self.lp_guide else None)
+        result = self._simulate_pack(problem, node_list, alloc, used,
+                                     compat, decode)
         if decode:
             # intra-batch anti-affinity/spread the masks can't express: a
             # violated placement disqualifies the whole action (the
@@ -391,6 +382,68 @@ class DisruptionController:
                 result.unschedulable = sorted(
                     set(result.unschedulable) | violations)
         return problem, result, node_list
+
+    def _simulate_pack(self, problem: Problem, node_list, alloc, used,
+                       compat, decode: bool) -> PackingResult:
+        """Simulation solve under the degradation ladder, mirroring
+        Provisioner._pack_supervised: healthy = legacy direct path
+        (sharded gate → classpack), failures fall one rung per attempt and
+        are booked in the shared SolverHealth; greedy is deadline-free and
+        re-raises — it is the floor."""
+        requested = "sharded" if (decode and self.sharded_solve) else "jax"
+        if self.health is None:
+            return self._simulate_rung(requested, problem, node_list,
+                                       alloc, used, compat, decode)
+        rung = self.health.active_rung(requested)
+        while True:
+            timeout = 0.0 if rung == "greedy" else self.watchdog_timeout_s
+            try:
+                result = run_with_deadline(
+                    lambda: self._simulate_rung(rung, problem, node_list,
+                                                alloc, used, compat, decode),
+                    timeout, "disruption.simulate")
+                self.health.report_success(rung)
+                return result
+            except WatchdogTimeout:
+                self.health.report_failure(rung, reason="timeout")
+            except Exception:
+                self.health.report_failure(rung, reason="error")
+                if rung == "greedy":
+                    raise
+            rung = self.health.active_rung(
+                self.health.next_rung(rung) or "greedy")
+
+    def _simulate_rung(self, rung: str, problem: Problem, node_list,
+                       alloc, used, compat, decode: bool) -> PackingResult:
+        """One simulation attempt on one rung.  A sharded refusal falls
+        through to the jax rung inline (routing, not failure).  The
+        native/greedy rungs run the pod-granular FFD — it always decodes,
+        which a decode=False probe tolerates (the caller only reads
+        aggregate fields of the PackingResult)."""
+        CHAOS.inject("solver.pack", key=rung)
+        ekw = dict(existing_alloc=alloc if len(node_list) else None,
+                   existing_used=used if len(node_list) else None,
+                   existing_compat=compat if len(node_list) else None)
+        if rung == "sharded":
+            result = maybe_solve_partitioned(
+                problem, path="disruption", max_nodes=2048,
+                node_list=node_list, **ekw)
+            if result is not None:
+                return result
+            rung = "jax"
+        if rung == "jax":
+            return solve_classpack(
+                problem, decode=decode,
+                # the LPGuide gate covers THIS path too: a fresh replacement
+                # solve (all candidates excluded, no survivors) would
+                # otherwise run the guide despite the escape hatch
+                guide="lp" if self.lp_guide else None, **ekw)
+        if rung == "native":
+            from .. import native
+            if not native.available():
+                raise RuntimeError("native packer unavailable on this host")
+            return solve_ffd(problem, max_nodes=2048, backend="native", **ekw)
+        return solve_ffd(problem, max_nodes=2048, backend="numpy", **ekw)
 
     # ------------------------------------------------------------------
     # methods, in reference order
@@ -553,7 +606,23 @@ class DisruptionController:
             return None
         if not self.batched_sweep:
             return self._consolidation_action_sequential(cands)
+        timeout = self.watchdog_timeout_s if self.health is not None else 0.0
+        try:
+            return run_with_deadline(
+                lambda: self._consolidation_action_batched(cands),
+                timeout, "disruption.sweep")
+        except WatchdogTimeout:
+            # hung device mid-sweep: book it against the jax rung (the
+            # arena kernels live there) and finish THIS tick on the
+            # sequential path, whose simulate() probes consult the
+            # now-demoted ladder
+            self.health.report_failure("jax", reason="timeout")
+            return self._consolidation_action_sequential(cands)
 
+    def _consolidation_action_batched(self,
+                                      cands: List[Candidate]
+                                      ) -> Optional[Action]:
+        CHAOS.inject("solver.sweep")
         sweep_hist = metrics.disruption_sweep_duration()
         t0 = time.perf_counter()
         with tracing.span("sweep.arena", candidates=len(cands)):

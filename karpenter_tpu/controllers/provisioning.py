@@ -39,7 +39,9 @@ from ..ops.tensorize import Problem, tensorize
 from ..parallel.driver import maybe_solve_partitioned
 from ..state.cluster import Cluster
 from ..utils import metrics, tracing
+from ..utils.chaos import CHAOS
 from ..utils.events import Event
+from ..utils.watchdog import WatchdogTimeout, run_with_deadline
 from ..utils.provenance import (CAPACITY, ProvenanceRecord,
                                 explain_unschedulable)
 
@@ -109,7 +111,9 @@ class Provisioner:
                  refinery=None,
                  recorder=None,
                  provenance=None,
-                 sharded_solve: bool = False):
+                 sharded_solve: bool = False,
+                 health=None,
+                 watchdog_timeout_s: float = 0.0):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
@@ -125,6 +129,13 @@ class Provisioner:
         # for small/unshardable batches and the round falls through to the
         # single-device path below.
         self.sharded_solve = sharded_solve
+        # degradation ladder (ops/health.py): shared SolverHealth state
+        # machine routing the pack step down sharded→jax→native→greedy as
+        # rungs fail; None (unit-test default) keeps the legacy direct
+        # path.  watchdog_timeout_s > 0 arms a hard deadline per pack call
+        # (utils/watchdog.py); 0 is a plain call.
+        self.health = health
+        self.watchdog_timeout_s = watchdog_timeout_s
         # the LPGuide feature gate: False routes classpack solves straight
         # to the greedy (guide=None) — the operational escape hatch.
         # With a refinery (LPRefinery gate), guide misses never block the
@@ -154,6 +165,75 @@ class Provisioner:
             return solve_ffd
         rows = int(problem.class_counts.sum()) + n_existing
         return solve_ffd if rows <= NATIVE_CUTOVER_ROWS else self._classpack
+
+    def _pack_supervised(self, problem: Problem, psp, existing):
+        """Run the pack step down the degradation ladder.  Healthy path is
+        byte-identical to the legacy direct call (sharded gate → jax);
+        with a SolverHealth wired, a watchdog trip or exception falls to
+        the next rung inside the SAME solve while the ladder books the
+        failure for future ticks.  The greedy rung is never deadline-
+        guarded (it is the guaranteed-terminating floor) and its
+        exceptions propagate — there is nothing below it."""
+        requested = "sharded" if self.sharded_solve else "jax"
+        if self.health is None:
+            return self._run_rung(requested, problem, psp, existing)
+        rung = self.health.active_rung(requested)
+        while True:
+            timeout = 0.0 if rung == "greedy" else self.watchdog_timeout_s
+            try:
+                result = run_with_deadline(
+                    lambda: self._run_rung(rung, problem, psp, existing),
+                    timeout, "provision.solve")
+                self.health.report_success(rung)
+                return result
+            except WatchdogTimeout:
+                self.health.report_failure(rung, reason="timeout")
+            except Exception:
+                self.health.report_failure(rung, reason="error")
+                if rung == "greedy":
+                    raise
+            rung = self.health.active_rung(
+                self.health.next_rung(rung) or "greedy")
+
+    def _run_rung(self, rung: str, problem: Problem, psp, existing):
+        """One pack attempt on one ladder rung.  A sharded refusal
+        (maybe_solve_partitioned → None: batch too small/unshardable) is
+        routing, not failure — it falls through to the jax rung inline,
+        exactly the legacy gate behavior."""
+        CHAOS.inject("solver.pack", key=rung)
+        kw: Dict[str, object] = {}
+        n_existing = 0
+        if existing is not None:
+            node_list, alloc, used, compat = existing
+            n_existing = len(node_list)
+            kw = dict(existing_alloc=alloc, existing_used=used,
+                      existing_compat=compat)
+        rows = int(problem.class_counts.sum()) + n_existing
+        if rung == "sharded":
+            result = maybe_solve_partitioned(
+                problem, path="provisioning",
+                max_nodes=self.max_nodes_per_round,
+                **(dict(kw, node_list=existing[0])
+                   if existing is not None else {}))
+            if result is not None:
+                psp.annotate(solver="sharded", rows=rows)
+                return result
+            rung = "jax"
+        if rung == "jax":
+            solve = self._pick_solver(problem, n_existing=n_existing)
+            psp.annotate(solver="ffd" if solve is solve_ffd else "classpack",
+                         rows=rows)
+            return solve(problem, max_nodes=self.max_nodes_per_round, **kw)
+        if rung == "native":
+            from .. import native
+            if not native.available():
+                raise RuntimeError("native packer unavailable on this host")
+            psp.annotate(solver="native", rows=rows)
+            return solve_ffd(problem, max_nodes=self.max_nodes_per_round,
+                             backend="native", **kw)
+        psp.annotate(solver="greedy", rows=rows)
+        return solve_ffd(problem, max_nodes=self.max_nodes_per_round,
+                         backend="numpy", **kw)
 
     def _pools_within_limits(self) -> List[NodePool]:
         usage = self.cluster.nodepool_usage()
@@ -241,6 +321,7 @@ class Provisioner:
                 tsp.annotate(pods=len(pods), classes=problem.num_classes,
                              options=problem.num_options)
             with tracing.span("solve.pack", level=level) as psp:
+                existing = None
                 if schedule_on_existing and node_view:
                     # warm arena gather only for the LIVE node set (nodes is
                     # None ⇒ node_view IS cluster.nodes.values(), under the
@@ -256,46 +337,9 @@ class Provisioner:
                         gathered = self.cluster.tensorize_nodes(
                             problem.class_reps, problem.axes,
                             scales=problem.scales, nodes=node_view)
-                    node_list, alloc, used, compat = gathered
-                    result = None
-                    if self.sharded_solve:
-                        result = maybe_solve_partitioned(
-                            problem, path="provisioning",
-                            max_nodes=self.max_nodes_per_round,
-                            existing_alloc=alloc, existing_used=used,
-                            existing_compat=compat, node_list=node_list)
-                    if result is not None:
-                        psp.annotate(
-                            solver="sharded",
-                            rows=int(problem.class_counts.sum()) + len(node_list))
-                    else:
-                        solve = self._pick_solver(problem,
-                                                  n_existing=len(node_list))
-                        psp.annotate(
-                            solver="ffd" if solve is solve_ffd else "classpack",
-                            rows=int(problem.class_counts.sum()) + len(node_list))
-                        result = solve(problem,
-                                       max_nodes=self.max_nodes_per_round,
-                                       existing_alloc=alloc, existing_used=used,
-                                       existing_compat=compat)
-                    result._existing_nodes = node_list
-                else:
-                    result = None
-                    if self.sharded_solve:
-                        result = maybe_solve_partitioned(
-                            problem, path="provisioning",
-                            max_nodes=self.max_nodes_per_round)
-                    if result is not None:
-                        psp.annotate(solver="sharded",
-                                     rows=int(problem.class_counts.sum()))
-                    else:
-                        solve = self._pick_solver(problem)
-                        psp.annotate(
-                            solver="ffd" if solve is solve_ffd else "classpack",
-                            rows=int(problem.class_counts.sum()))
-                        result = solve(problem,
-                                       max_nodes=self.max_nodes_per_round)
-                    result._existing_nodes = []
+                    existing = gathered  # (node_list, alloc, used, compat)
+                result = self._pack_supervised(problem, psp, existing)
+                result._existing_nodes = existing[0] if existing else []
                 psp.annotate(scheduled=result.scheduled_count,
                              unschedulable=len(result.unschedulable))
             if best is None or result.scheduled_count > best[1].scheduled_count:

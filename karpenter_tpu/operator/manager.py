@@ -23,7 +23,9 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..utils import metrics, tracing
+from ..utils.chaos import CHAOS
 from .options import Options
+from .supervisor import BackoffPolicy, ControllerSupervisor
 
 log = logging.getLogger("karpenter_tpu.manager")
 
@@ -187,6 +189,22 @@ class ControllerManager:
             # cannot be caught mid-flight
             metrics.controller_max_concurrent().set(1, {"controller": name})
             metrics.controller_active_workers().set(0, {"controller": name})
+        # one supervisor per controller (provisioning included): isolates
+        # crash loops with backoff + circuit breaking while every other
+        # entry keeps cadence (operator/supervisor.py)
+        policy = BackoffPolicy(
+            base_s=getattr(operator.options, "supervisor_backoff_base_s", 1.0),
+            max_s=getattr(operator.options, "supervisor_backoff_max_s", 300.0))
+        threshold = getattr(operator.options,
+                            "supervisor_circuit_threshold", 5)
+        recorder = getattr(operator, "recorder", None)
+        self.supervisors: Dict[str, ControllerSupervisor] = {
+            name: ControllerSupervisor(name, policy=policy,
+                                       circuit_threshold=threshold,
+                                       recorder=recorder)
+            for name in list(controllers) }
+        self._soft_deadline_s = getattr(operator.options,
+                                        "reconcile_soft_deadline_s", 5.0)
         self._stop = threading.Event()
         self._http: Optional[http.server.ThreadingHTTPServer] = None
         # serializes cluster-state access between the tick loop, the /v1
@@ -230,31 +248,70 @@ class ControllerManager:
             if not ripe and pending and refinery is not None \
                     and refinery.take_upgrade():
                 ripe = True
-            if ripe:
+            if ripe and self.supervisors["provisioning"].allow(now):
                 # real pending pods evict headroom placeholders BEFORE the
                 # solve so the freed warm capacity is schedulable this tick
                 # — that immediacy is the whole point of headroom
                 forecast = self.controllers.get("forecast")
                 if forecast is not None:
                     forecast.preempt_for_pending()
-                results["provisioning"] = prov.provision()
-                self.batch_window.reset()
+                if self._supervised(now, "provisioning", prov.provision,
+                                    results):
+                    # the window survives a failed solve: the pods are
+                    # still pending and the batch is still ripe, so the
+                    # supervisor's backoff (not a reopened window) paces
+                    # the retry
+                    self.batch_window.reset()
         for e in self._entries:
             if now - e.last_run < e.interval:
                 continue
+            if not self.supervisors[e.name].allow(now):
+                continue  # backoff window: last_run stays put, so cadence
+                          # resumes the moment the supervisor re-allows
             e.last_run = now
-            t0 = time.perf_counter()
-            try:
-                results[e.name] = e.reconcile()
-            except Exception:
-                metrics.controller_reconcile_errors().inc(
-                    {"controller": e.name})
-                log.exception("controller %s reconcile failed", e.name)
-            finally:
-                metrics.controller_reconciles().inc({"controller": e.name})
-                metrics.controller_reconcile_time().observe(
-                    time.perf_counter() - t0, {"controller": e.name})
+            self._supervised(now, e.name, e.reconcile, results)
         return results
+
+    def _supervised(self, now: float, name: str,
+                    reconcile: Callable[[], object],
+                    results: Dict[str, object]) -> bool:
+        """Run one reconcile under its supervisor.  Failures are contained
+        here (counted, backed off, possibly quarantined) so sibling
+        controllers always reach their turn.  Returns success."""
+        sup = self.supervisors[name]
+        t0 = time.perf_counter()
+        try:
+            CHAOS.inject("controller.reconcile", key=name)
+            results[name] = reconcile()
+            sup.record_success(now)
+            return True
+        except Exception as err:
+            sup.record_failure(now, err)
+            metrics.controller_reconcile_errors().inc({"controller": name})
+            log.exception("controller %s reconcile failed", name)
+            return False
+        finally:
+            elapsed = time.perf_counter() - t0
+            metrics.controller_reconciles().inc({"controller": name})
+            metrics.controller_reconcile_time().observe(
+                elapsed, {"controller": name})
+            if 0 < self._soft_deadline_s < elapsed:
+                tracing.annotate(soft_deadline_exceeded=name)
+                log.warning("controller %s reconcile took %.3fs "
+                            "(soft deadline %.1fs)",
+                            name, elapsed, self._soft_deadline_s)
+
+    def health_snapshot(self) -> Dict:
+        """Supervision + solver-ladder state for /debug/health."""
+        prov = self.controllers.get("provisioning")
+        health = getattr(prov, "health", None) if prov is not None else None
+        snap: Dict[str, object] = {
+            "controllers": {name: sup.snapshot()
+                            for name, sup in sorted(self.supervisors.items())},
+        }
+        if health is not None:
+            snap["solver"] = health.snapshot()
+        return snap
 
     def run(self, tick_seconds: float = 0.25,
             stop_after: Optional[float] = None) -> None:
@@ -474,6 +531,10 @@ class ControllerManager:
                         self._json({"error": "min_ms must be a number"}, 400)
                         return
                     self._json({"traces": tracing.TRACER.traces(min_ms)})
+                    return
+                elif url.path == "/debug/health":
+                    # supervisor circuits + solver degradation ladder
+                    self._json(manager.health_snapshot())
                     return
                 elif url.path.startswith("/debug/pods/"):
                     # per-pod scheduling provenance (why is this pod pending)

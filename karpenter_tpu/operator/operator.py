@@ -146,12 +146,30 @@ class Operator:
                                            lock=self.state_lock))
         self.node_classes: Dict[str, NodeClass] = {"default": NodeClass()}  # guarded-by: caller(state_lock)
         self.nodepools: Dict[str, NodePool] = {"default": NodePool()}  # guarded-by: caller(state_lock)
+        # cloud-call hardening (docs/robustness.md): both default OFF —
+        # the sim's virtual clock must never wall-sleep in a retry loop
+        retry = breaker = None
+        if int(getattr(self.options, "cloud_retry_attempts", 0)) > 0:
+            from ..cloud.provider import RetryPolicy
+            retry = RetryPolicy(
+                attempts=int(self.options.cloud_retry_attempts),
+                base_s=float(self.options.cloud_retry_base_s))
+        if int(getattr(self.options, "cloud_breaker_threshold", 0)) > 0:
+            from ..cloud.provider import ProviderCircuitBreaker
+            breaker = ProviderCircuitBreaker(
+                threshold=int(self.options.cloud_breaker_threshold),
+                cooldown_s=float(self.options.cloud_breaker_cooldown_s),
+                clock=clock)
         self.cloud_provider = CloudProvider(
             self.batched_cloud, self.catalog, unavailable=self.unavailable,
             node_classes=self.node_classes,
             cluster_name=self.options.cluster_name, clock=clock,
             subnets=self.subnets, launch_templates=self.launch_templates,
-            pricing=self.pricing)
+            pricing=self.pricing, retry=retry, breaker=breaker)
+        # live-operator chaos arming (--chaos-spec); the sim configures the
+        # injector itself so schedules ride the virtual clock
+        from ..utils.chaos import maybe_configure_from_options
+        maybe_configure_from_options(self.options)
         self.hydrate_cluster()
 
     def hydrate_cluster(self) -> int:
@@ -185,42 +203,55 @@ class Operator:
             log.info("hydrated %d nodes from cloud state", n)
         return n
 
-    def apply_batch(self, manifests) -> list:
-        """Atomic-intent batch apply: phase 1 runs EVERY manifest through
-        the same admission checks `apply` performs — legacy conversion,
-        schema validation, defaulting-time parsing, update immutability
-        against both live state AND earlier manifests in the batch (a
-        create followed by an immutable-field update in one batch must
-        fail up front) — phase 2 registers the objects phase 1 already
-        admitted, so admission runs exactly once per manifest.  A phase-1
-        failure means nothing was applied."""
+    def _admit(self, manifest: Dict, pending_nc: Optional[Dict] = None):
+        """Admission phase 1 — the ONE shared gate behind both `apply` and
+        `apply_batch` (webhook semantics, pkg/webhooks/webhooks.go:44-63):
+        legacy manifests are schema-checked against THEIR OWN kind's schema
+        before conversion (a malformed Provisioner/Machine gets an error
+        naming the kind the user submitted), then converted, re-validated,
+        and parsed with defaulting.  NodeClass update immutability checks
+        against live state or — in a batch — an earlier staged manifest of
+        the same name, via `pending_nc` (a create followed by an
+        immutable-field update in one batch must fail up front).  Returns
+        `(kind, obj)` ready for `_register`; any admission change lands in
+        both entry points automatically."""
         from ..api.admission import validate_manifest, validate_nodeclass_update
         from ..api.legacy import convert_manifest
         from ..api.serialize import (nodeclaim_from_manifest,
                                      nodeclass_from_manifest,
                                      nodepool_from_manifest)
+        validate_manifest(manifest)
+        manifest = convert_manifest(manifest)
+        validate_manifest(manifest)
+        kind = manifest.get("kind")
+        if kind == "NodePool":
+            return kind, nodepool_from_manifest(manifest)
+        if kind == "NodeClass":
+            obj = nodeclass_from_manifest(manifest)  # defaults + validates
+            original = (pending_nc or {}).get(obj.name) or \
+                self.node_classes.get(obj.name)
+            if original is not None:
+                validate_nodeclass_update(original, obj)
+            if pending_nc is not None:
+                pending_nc[obj.name] = obj
+            return kind, obj
+        if kind == "NodeClaim":
+            return kind, nodeclaim_from_manifest(manifest)
+        raise ValueError(f"cannot apply kind {kind!r}")
+
+    def apply_batch(self, manifests) -> list:
+        """Atomic-intent batch apply: phase 1 runs EVERY manifest through
+        `_admit` — the exact admission gate `apply` uses — threading the
+        batch-local `pending_nc` map so immutability is checked against
+        earlier manifests in the batch as well as live state; phase 2
+        registers the objects phase 1 already admitted, so admission runs
+        exactly once per manifest.  A phase-1 failure means nothing was
+        applied."""
         pending_nc: Dict[str, object] = {}
         staged: List = []
         for manifest in manifests:
             try:
-                validate_manifest(manifest)
-                m = convert_manifest(manifest)
-                validate_manifest(m)
-                kind = m.get("kind")
-                if kind == "NodePool":
-                    staged.append((kind, nodepool_from_manifest(m)))
-                elif kind == "NodeClass":
-                    nc = nodeclass_from_manifest(m)
-                    original = pending_nc.get(nc.name) or \
-                        self.node_classes.get(nc.name)
-                    if original is not None:
-                        validate_nodeclass_update(original, nc)
-                    pending_nc[nc.name] = nc
-                    staged.append((kind, nc))
-                elif kind == "NodeClaim":
-                    staged.append((kind, nodeclaim_from_manifest(m)))
-                else:
-                    raise ValueError(f"cannot apply kind {kind!r}")
+                staged.append(self._admit(manifest, pending_nc))
             except (ValueError, KeyError, TypeError) as e:
                 raise ValueError(
                     f"{manifest.get('kind')}/"
@@ -230,34 +261,11 @@ class Operator:
 
     def apply(self, manifest: Dict):
         """Admission-checked manifest ingestion — the kubectl-apply analog:
-        default + validate (webhook semantics, pkg/webhooks/webhooks.go:44-63)
-        and register into the live controller state (dict shared with the
-        provisioner/disruption controllers).  Legacy alpha kinds convert
-        first (karpenter-convert semantics).  Returns the registered object."""
-        from ..api.admission import validate_manifest, validate_nodeclass_update
-        from ..api.legacy import convert_manifest
-        from ..api.serialize import (nodeclass_from_manifest,
-                                     nodepool_from_manifest)
-        # legacy manifests are schema-checked against THEIR OWN kind's
-        # schema before conversion — a malformed Provisioner/Machine gets an
-        # admission error naming the kind the user submitted, not a raw
-        # converter exception or an error about the converted kind
-        validate_manifest(manifest)
-        manifest = convert_manifest(manifest)
-        validate_manifest(manifest)
-        kind = manifest.get("kind")
-        if kind == "NodePool":
-            obj = nodepool_from_manifest(manifest)   # defaults + validates
-        elif kind == "NodeClass":
-            obj = nodeclass_from_manifest(manifest)  # defaults + validates
-            original = self.node_classes.get(obj.name)
-            if original is not None:
-                validate_nodeclass_update(original, obj)
-        elif kind == "NodeClaim":
-            from ..api.serialize import nodeclaim_from_manifest
-            obj = nodeclaim_from_manifest(manifest)
-        else:
-            raise ValueError(f"cannot apply kind {kind!r}")
+        `_admit` defaults + validates (legacy alpha kinds convert first,
+        karpenter-convert semantics) and `_register` records the object in
+        live controller state (dicts shared with the provisioner/disruption
+        controllers).  Returns the registered object."""
+        kind, obj = self._admit(manifest)
         return self._register(kind, obj)
 
     def _register(self, kind: str, obj):
@@ -336,13 +344,21 @@ def build_controllers(op: Operator) -> Dict[str, object]:
         # both clocks ride the operator's injected clock: staleness AND
         # drain deadlines follow virtual time under the simulator
         refinery = GuideRefinery(clock=op.clock, monotonic=op.clock)
+    # ONE degradation ladder shared by provisioning and disruption: a rung
+    # that times out in either solver demotes for both, so the whole tick
+    # loop falls to the same guaranteed-terminating floor together
+    from ..ops.health import SolverHealth
+    health = SolverHealth(clock=op.clock)
+    solve_timeout = float(getattr(op.options, "solve_timeout_s", 0.0) or 0.0)
     provisioner = Provisioner(
         op.cloud_provider, op.cluster, op.nodepools,
         lp_guide=op.options.gate("LPGuide"),
         refinery=refinery,
         recorder=op.recorder,
         provenance=op.provenance,
-        sharded_solve=op.options.gate("ShardedSolve"))
+        sharded_solve=op.options.gate("ShardedSolve"),
+        health=health,
+        watchdog_timeout_s=solve_timeout)
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {
@@ -354,7 +370,9 @@ def build_controllers(op: Operator) -> Dict[str, object]:
             drift_enabled=op.options.gate("Drift"),
             lp_guide=op.options.gate("LPGuide"),
             recorder=op.recorder,
-            sharded_solve=op.options.gate("ShardedSolve")),
+            sharded_solve=op.options.gate("ShardedSolve"),
+            health=health,
+            watchdog_timeout_s=solve_timeout),
         "lifecycle": LifecycleController(
             op.cloud_provider, op.cluster, nodepools=op.nodepools,
             recorder=op.recorder, clock=op.clock),

@@ -89,6 +89,22 @@ class Options:
     forecast_max_cost_frac: float = 0.10   # headroom $/h cap vs cluster rate
     forecast_model: str = "holtwinters"    # "ewma" | "holtwinters"
     forecast_season_s: float = 86_400.0    # Holt-Winters season (diurnal)
+    # robustness knobs (docs/robustness.md): controller supervision,
+    # watchdog deadlines, the solver degradation ladder, cloud-call
+    # hardening, and the chaos injector.  Retry/breaker/chaos default OFF
+    # so the virtual-clock sim and all goldens are byte-identical unless
+    # a scenario arms them explicitly.
+    supervisor_circuit_threshold: int = 5   # consecutive errors → quarantine
+    supervisor_backoff_base_s: float = 1.0  # first retry delay
+    supervisor_backoff_max_s: float = 300.0  # backoff ceiling
+    reconcile_soft_deadline_s: float = 5.0  # warn + annotate past this
+    solve_timeout_s: float = 0.0            # hard solver deadline (0 = off)
+    cloud_retry_attempts: int = 0           # extra tries per cloud call
+    cloud_retry_base_s: float = 0.2         # retry backoff base
+    cloud_breaker_threshold: int = 0        # failures → open circuit (0 = off)
+    cloud_breaker_cooldown_s: float = 30.0  # open-circuit fast-fail window
+    chaos_spec: str = ""                    # utils/chaos.py rule DSL (off)
+    chaos_seed: int = 0                     # chaos schedule seed
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
@@ -169,6 +185,51 @@ class Options:
                             "mesh by zone-compatibility group (shorthand "
                             "for --feature-gates ShardedSolve=true; "
                             "no-op on <2 devices)")
+        p.add_argument("--supervisor-circuit-threshold", type=int,
+                       default=env.get("supervisor_circuit_threshold", 5),
+                       help="consecutive reconcile errors before a "
+                            "controller's circuit opens (quarantine)")
+        p.add_argument("--supervisor-backoff-base", type=float,
+                       dest="supervisor_backoff_base_s",
+                       default=env.get("supervisor_backoff_base_s", 1.0),
+                       help="first crash-loop retry delay in seconds")
+        p.add_argument("--supervisor-backoff-max", type=float,
+                       dest="supervisor_backoff_max_s",
+                       default=env.get("supervisor_backoff_max_s", 300.0),
+                       help="crash-loop backoff ceiling in seconds")
+        p.add_argument("--reconcile-soft-deadline", type=float,
+                       dest="reconcile_soft_deadline_s",
+                       default=env.get("reconcile_soft_deadline_s", 5.0),
+                       help="warn + trace-annotate reconciles slower than "
+                            "this many seconds (0 disables)")
+        p.add_argument("--solve-timeout", type=float, dest="solve_timeout_s",
+                       default=env.get("solve_timeout_s", 0.0),
+                       help="hard cancellable deadline for solver calls; a "
+                            "trip demotes the degradation ladder "
+                            "(0 disables)")
+        p.add_argument("--cloud-retry-attempts", type=int,
+                       default=env.get("cloud_retry_attempts", 0),
+                       help="extra in-call retries for retryable cloud "
+                            "errors (0 disables)")
+        p.add_argument("--cloud-retry-base", type=float,
+                       dest="cloud_retry_base_s",
+                       default=env.get("cloud_retry_base_s", 0.2),
+                       help="cloud retry backoff base in seconds")
+        p.add_argument("--cloud-breaker-threshold", type=int,
+                       default=env.get("cloud_breaker_threshold", 0),
+                       help="consecutive cloud failures before launches "
+                            "fast-fail for a cooldown (0 disables)")
+        p.add_argument("--cloud-breaker-cooldown", type=float,
+                       dest="cloud_breaker_cooldown_s",
+                       default=env.get("cloud_breaker_cooldown_s", 30.0),
+                       help="cloud circuit-open cooldown in seconds")
+        p.add_argument("--chaos-spec",
+                       default=env.get("chaos_spec", ""),
+                       help="chaos rule DSL 'point=...,action=...;...' "
+                            "(utils/chaos.py; empty disables injection)")
+        p.add_argument("--chaos-seed", type=int,
+                       default=env.get("chaos_seed", 0),
+                       help="seed for the deterministic chaos schedule")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -193,6 +254,17 @@ class Options:
             forecast_lead_s=ns.forecast_lead_s,
             forecast_ttl_s=ns.forecast_ttl_s,
             forecast_model=ns.forecast_model,
+            supervisor_circuit_threshold=ns.supervisor_circuit_threshold,
+            supervisor_backoff_base_s=ns.supervisor_backoff_base_s,
+            supervisor_backoff_max_s=ns.supervisor_backoff_max_s,
+            reconcile_soft_deadline_s=ns.reconcile_soft_deadline_s,
+            solve_timeout_s=ns.solve_timeout_s,
+            cloud_retry_attempts=ns.cloud_retry_attempts,
+            cloud_retry_base_s=ns.cloud_retry_base_s,
+            cloud_breaker_threshold=ns.cloud_breaker_threshold,
+            cloud_breaker_cooldown_s=ns.cloud_breaker_cooldown_s,
+            chaos_spec=ns.chaos_spec,
+            chaos_seed=ns.chaos_seed,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
@@ -232,6 +304,16 @@ class Options:
             "forecast_confidence": float,
             "forecast_max_cost_frac": float,
             "forecast_season_s": float,
+            "supervisor_circuit_threshold": int,
+            "supervisor_backoff_base_s": float,
+            "supervisor_backoff_max_s": float,
+            "reconcile_soft_deadline_s": float,
+            "solve_timeout_s": float,
+            "cloud_retry_attempts": int,
+            "cloud_retry_base_s": float,
+            "cloud_breaker_threshold": int,
+            "cloud_breaker_cooldown_s": float,
+            "chaos_seed": int,
         }
         for f in fields(Options):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())

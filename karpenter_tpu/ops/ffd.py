@@ -165,6 +165,68 @@ class SweepResult:
 NATIVE_CUTOVER_ROWS = 256
 
 
+def ffd_pack_numpy(requests: np.ndarray,     # P×R float32, FFD-sorted
+                   compat: np.ndarray,       # P×(O+E) bool
+                   class_ids: np.ndarray,    # P int32
+                   row_caps: np.ndarray,     # P int32
+                   rem: np.ndarray,          # P int32
+                   alloc: np.ndarray,        # (O+E)×R float32
+                   price: np.ndarray,        # O+E float32, existing = inf
+                   rank: np.ndarray,         # O+E int32
+                   init_option: np.ndarray,  # K int32
+                   init_used: np.ndarray,    # K×R float32
+                   K: int):
+    """Pure-NumPy mirror of `ffd_pack_kernel` on UNPADDED arrays — the
+    degradation ladder's guaranteed-terminating greedy bottom rung
+    (ops/health.py): no device, no compile, no C extension, one bounded
+    Python loop.  Semantics (first-fit slot choice, tail-aware new-node
+    score, float32 arithmetic and the SCORE_CAP clamp) track the scan
+    step exactly so plans stay backend-comparable."""
+    P, _ = requests.shape
+    IBIG = np.int32(2**30)
+    f32 = np.float32
+    slot_option = init_option.astype(np.int32).copy()
+    slot_used = init_used.astype(f32).copy()
+    slot_cls = np.zeros(K, np.int32)
+    prev_cid = None
+    n_open = int((slot_option >= 0).sum())
+    assignment = np.full(P, NO_ASSIGNMENT, np.int32)
+    for i in range(P):
+        req = requests[i]
+        comp = compat[i]
+        cid = int(class_ids[i])
+        cap = int(row_caps[i])
+        if cid != prev_cid:
+            slot_cls[:] = 0
+        prev_cid = cid
+        opt = np.maximum(slot_option, 0)
+        fits = ((slot_option >= 0) & comp[opt] & (slot_cls < cap)
+                & np.all(slot_used + req <= alloc[opt], axis=-1))
+        if fits.any():
+            k = int(np.argmax(fits))
+        else:
+            new_ok = comp & np.all(req <= alloc, axis=-1) & np.isfinite(price)
+            if not new_ok.any() or n_open >= K:
+                continue  # row stays NO_ASSIGNMENT
+            best_rank = np.min(np.where(new_ok, rank, IBIG))
+            new_ok_r = new_ok & (rank == best_rank)
+            reqpos = req > 0
+            safe_req = np.where(reqpos, req, f32(1.0))
+            m = np.min(np.where(reqpos[None, :],
+                                np.floor(alloc / safe_req[None, :]),
+                                f32(2**30)), axis=-1)
+            m = np.clip(m, f32(1.0), f32(max(cap, 1)))
+            score = np.minimum(
+                price * np.ceil(f32(max(int(rem[i]), 1)) / m), f32(SCORE_CAP))
+            k = n_open
+            slot_option[k] = int(np.argmin(np.where(new_ok_r, score, np.inf)))
+            n_open += 1
+        slot_used[k] += req
+        slot_cls[k] += 1
+        assignment[i] = k
+    return assignment, slot_option, slot_used, n_open
+
+
 def rem_in_class(class_ids: np.ndarray) -> np.ndarray:
     """Per row: rows of the row's class still unplaced (itself included) —
     rows are class-contiguous, so this is count-from-the-back.  Feeds the
@@ -197,8 +259,10 @@ def solve_ffd(problem: Problem,
     options.
 
     `backend`: "jax" (scan kernel), "native" (C++ packer — identical slot
-    semantics, see karpenter_tpu/native), or "auto" — native for small rows
-    where kernel-launch latency dominates, accelerator otherwise.
+    semantics, see karpenter_tpu/native), "numpy" (pure-host greedy mirror,
+    the degradation ladder's bottom rung — always available, always
+    terminates), or "auto" — native for small rows where kernel-launch
+    latency dominates, accelerator otherwise.
     """
     if backend == "auto":
         total_rows = int(problem.class_counts.sum()) + \
@@ -245,6 +309,23 @@ def solve_ffd(problem: Problem,
     new_price = price.copy()
     if E:
         new_price[O:] = np.inf  # existing nodes can't be "launched" again
+
+    if backend == "numpy":
+        tracing.annotate(backend="numpy", device_calls=0)
+        init_option = np.full(K, -1, np.int32)
+        init_used = np.zeros((K, R), np.float32)
+        if E:
+            init_option[:E] = np.arange(O, O + E, dtype=np.int32)
+            init_used[:E] = existing_used.astype(np.float32) \
+                if existing_used is not None else 0.0
+        assignment, slot_option, slot_used, _ = ffd_pack_numpy(
+            requests.astype(np.float32), compat,
+            class_ids.astype(np.int32), row_caps,
+            rem_in_class(class_ids), alloc.astype(np.float32),
+            new_price.astype(np.float32), rank, init_option, init_used, K)
+        return decode_assignment(problem, assignment, slot_option,
+                                 slot_used, pod_idx, compat, E, O,
+                                 max_alternatives)
 
     # pad both the pod axis and the option axis (columns) so catalog/ICE/
     # cluster-size changes reuse compiled programs instead of recompiling

@@ -1,0 +1,167 @@
+"""SolverHealth: one degradation ladder over the solver path zoo.
+
+Before this module the fallbacks were piecewise and stateless: the
+partitioned driver falls back to single-device on refusal
+(parallel/driver.py), solve_ffd falls back from native when the C++ core
+is unavailable, the LP guide falls back to greedy on a cold cache.  None
+of them REMEMBER: a device that hangs every tick is retried every tick.
+
+`SolverHealth` is the shared state machine both solve paths
+(Provisioner.solve, DisruptionController.simulate) consult:
+
+    sharded ──▶ jax ──▶ native ──▶ greedy
+
+Repeated errors (or a single watchdog timeout — a hung device must not
+get a second chance inside the same incident) demote a rung for a
+backoff window that doubles per consecutive demotion; when the window
+expires the next solve is a half-open probe — success promotes back
+instantly, failure re-demotes for a longer window.  The greedy rung
+(pure-NumPy FFD, ops/ffd.py backend="numpy") never demotes: it touches
+no device, terminates by construction, and guarantees every tick still
+produces *a* plan.
+
+Every transition is logged, traced onto the active span, and counted in
+karpenter_degradation_transitions_total{from,to,reason}.  The clock is
+injectable so the ladder is deterministic under the sim's virtual clock.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from ..utils import metrics, tracing
+
+log = logging.getLogger("karpenter_tpu.health")
+
+# Ladder order, best rung first.  "sharded" = partitioned mesh solve,
+# "jax" = the single-device kernels (classpack or scan FFD), "native" =
+# the C++ packer, "greedy" = host NumPy FFD (guaranteed bottom).
+RUNGS = ("sharded", "jax", "native", "greedy")
+RUNG_INDEX = {r: i for i, r in enumerate(RUNGS)}
+
+DEMOTE_AFTER_ERRORS = 2       # consecutive errors before demotion
+DEFAULT_WINDOW_S = 60.0       # first demotion window
+DEFAULT_WINDOW_MAX_S = 600.0  # doubling cap
+
+
+@dataclass
+class _RungState:
+    failures: int = 0            # consecutive errors since last success
+    demotions: int = 0           # consecutive demotions (window doubling)
+    demoted_until: float = float("-inf")
+    probing: bool = False        # a half-open probe is in flight
+    total_failures: int = 0
+    total_demotions: int = 0
+
+
+class SolverHealth:
+    """Shared ladder state.  Callers hold the state lock for the solve
+    paths that consult this, so no internal locking is needed; the
+    /debug/health snapshot reads plain attributes."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 demote_after: int = DEMOTE_AFTER_ERRORS,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 window_max_s: float = DEFAULT_WINDOW_MAX_S):
+        self.clock = clock
+        self.demote_after = max(1, int(demote_after))
+        self.window_s = float(window_s)
+        self.window_max_s = float(window_max_s)
+        self._state: Dict[str, _RungState] = {r: _RungState() for r in RUNGS}
+        # deterministic transition tally for reports: "from>to:reason" → n
+        self.transitions: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def active_rung(self, requested: str = "jax") -> str:
+        """Best non-demoted rung at or below `requested`.  An expired
+        demotion window turns the rung into a half-open probe: it is
+        offered exactly once; failure re-demotes, success promotes."""
+        now = self.clock()
+        for rung in RUNGS[RUNG_INDEX[requested]:]:
+            st = self._state[rung]
+            if st.demoted_until <= now:
+                if st.demotions and not st.probing:
+                    st.probing = True
+                    log.info("solver rung %s: half-open probe", rung)
+                return rung
+        return "greedy"  # unreachable: greedy never demotes
+
+    def next_rung(self, rung: str) -> Optional[str]:
+        i = RUNG_INDEX[rung] + 1
+        return RUNGS[i] if i < len(RUNGS) else None
+
+    # ------------------------------------------------------------------
+    def report_success(self, rung: str) -> None:
+        st = self._state[rung]
+        if st.probing or st.demotions:
+            self._transition(rung, rung, "recovered")
+        st.failures = 0
+        st.demotions = 0
+        st.probing = False
+        st.demoted_until = float("-inf")
+        self._export_rung()
+
+    def report_failure(self, rung: str, reason: str = "error") -> None:
+        """`reason` is "timeout" (watchdog trip — demote immediately) or
+        "error" (demote after `demote_after` consecutive failures, or
+        immediately when the failure hit a half-open probe)."""
+        st = self._state[rung]
+        st.failures += 1
+        st.total_failures += 1
+        if rung == "greedy":
+            return  # bottom rung: never demoted, failures only counted
+        if reason == "timeout" or st.probing or \
+                st.failures >= self.demote_after:
+            st.probing = False
+            st.failures = 0
+            st.demotions += 1
+            st.total_demotions += 1
+            window = min(self.window_s * (2.0 ** (st.demotions - 1)),
+                         self.window_max_s)
+            st.demoted_until = self.clock() + window
+            self._transition(rung, self.next_rung(rung) or rung, reason)
+        self._export_rung()
+
+    # ------------------------------------------------------------------
+    def _transition(self, frm: str, to: str, reason: str) -> None:
+        key = f"{frm}>{to}:{reason}"
+        self.transitions[key] = self.transitions.get(key, 0) + 1
+        metrics.degradation_transitions().inc(
+            {"from": frm, "to": to, "reason": reason})
+        tracing.annotate(degradation=key)
+        if reason == "recovered":
+            log.info("solver ladder: rung %s recovered", frm)
+        else:
+            log.warning("solver ladder: %s demoted to %s (%s), window %.0fs",
+                        frm, to, reason,
+                        self._state[frm].demoted_until - self.clock())
+
+    def _export_rung(self) -> None:
+        # lowest healthy rung index as a gauge (0 = sharded best rung)
+        now = self.clock()
+        for i, rung in enumerate(RUNGS):
+            if self._state[rung].demoted_until <= now:
+                metrics.degradation_rung().set(i)
+                return
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict:
+        """Deterministic ladder state for /debug/health and tests."""
+        now = self.clock()
+        return {
+            "rungs": {
+                rung: {
+                    "demoted": st.demoted_until > now,
+                    "demoted_for_s": round(max(0.0, st.demoted_until - now), 3),
+                    "consecutive_failures": st.failures,
+                    "consecutive_demotions": st.demotions,
+                    "probing": st.probing,
+                    "total_failures": st.total_failures,
+                    "total_demotions": st.total_demotions,
+                } for rung in RUNGS for st in (self._state[rung],)
+            },
+            "transitions": dict(sorted(self.transitions.items())),
+        }

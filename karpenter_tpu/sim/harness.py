@@ -45,6 +45,7 @@ from ..operator.manager import ControllerManager
 from ..operator.operator import Operator, build_controllers
 from ..operator.options import Options
 from ..utils import metrics
+from ..utils.chaos import CHAOS, ChaosRule
 from . import events as ev
 from .clock import EventHeap, VirtualClock
 from .scenario import Scenario, expand
@@ -210,6 +211,12 @@ class SimHarness:
         self._reclaims_honored = 0
         self._reclaims_forced = 0
         self._tick_exceptions = 0
+        # provisioning faults are absorbed by its supervisor now, not
+        # re-raised through tick(); the report's tick_exceptions counter
+        # tracks the supervisor's failure total instead (same semantics)
+        self._prov_failures_seen = 0
+        ch = scenario.chaos
+        self._chaos_enabled = bool(ch is not None and ch.enabled and ch.rules)
 
     # ------------------------------------------------------------------
     def _wrap_register(self) -> None:
@@ -375,13 +382,22 @@ class SimHarness:
         try:
             results = self.mgr.tick()
         except Exception as e:
-            # provisioning runs unguarded inside the manager; a solver or
-            # cloud fault (e.g. an injected throttle burst) must cost one
-            # tick, not the run — and not a traceback per retry
+            # the supervisors absorb controller faults, so anything that
+            # reaches here is a harness/manager bug — still cost one tick,
+            # not the run, and not a traceback per retry
             self._tick_exceptions += 1
             log.warning("sim tick failed at t=%.1f: %s",
                         self.clock.now(), e)
             return
+        # provisioning faults (e.g. an injected throttle burst) used to
+        # propagate out of tick(); its supervisor now catches them, so the
+        # report counter follows the supervisor's running failure total
+        prov_sup = self.mgr.supervisors.get("provisioning")
+        if prov_sup is not None and \
+                prov_sup.total_failures > self._prov_failures_seen:
+            self._tick_exceptions += \
+                prov_sup.total_failures - self._prov_failures_seen
+            self._prov_failures_seen = prov_sup.total_failures
         disruption = results.get("disruption")
         if disruption is not None and disruption.action is not None:
             name = disruption.action.name
@@ -412,7 +428,13 @@ class SimHarness:
                 continue
             if entry.name == "lifecycle" and not lifecycle_busy:
                 continue
-            due = min(due, entry.last_run + entry.interval)
+            edue = entry.last_run + entry.interval
+            sup = self.mgr.supervisors.get(entry.name)
+            if sup is not None:
+                # a crash-looping controller's backoff window is jumped,
+                # not crawled through the zero-advance guard
+                edue = max(edue, sup.next_allowed())
+            due = min(due, edue)
         window = self.mgr.batch_window
         if self.cluster.pending_pods():
             if window._opened is None:
@@ -420,14 +442,43 @@ class SimHarness:
             else:
                 wdue = min(window._last_add + window.idle,
                            window._opened + window.max_timeout)
-            # while a throttle burst has the cloud refusing every call,
-            # re-solving just burns ticks — back the launch path off to
-            # the window's end like a live controller's retry would
-            due = min(due, max(wdue, self.cloud.throttle_until))
+            # while a throttle burst has the cloud refusing every call —
+            # or the provisioning supervisor is backing a crash loop off —
+            # re-solving just burns ticks: hold the launch path to the
+            # latest of the window close, the throttle end, and the
+            # supervisor's retry time, like a live controller's retry
+            prov_sup = self.mgr.supervisors.get("provisioning")
+            prov_at = prov_sup.next_allowed() if prov_sup else float("-inf")
+            due = min(due, max(wdue, self.cloud.throttle_until, prov_at))
         return due
 
     # ------------------------------------------------------------------
     def run(self) -> SimRun:
+        if not self._chaos_enabled:
+            return self._run_loop()
+        ch = self.scenario.chaos
+        sc = self.scenario
+        # rebase scenario-relative rule times onto the virtual clock; the
+        # no-op sleep keeps latency/hang rules from burning wall time (a
+        # hang is only meaningful under a watchdog deadline, which uses
+        # its own wall-clock wait)
+        rules = [ChaosRule(point=r.point, key=r.key, action=r.action,
+                           rate=r.rate, at_s=sc.start_s + r.at_s,
+                           until_s=(sc.start_s + r.until_s) if r.until_s
+                           else float("inf"),
+                           latency_s=r.latency_s, count=r.count,
+                           error_code=r.error_code)
+                 for r in ch.rules]
+        CHAOS.configure(rules,
+                        seed=self.seed if ch.seed is None else int(ch.seed),
+                        clock=self.clock, sleep=lambda s: None)
+        try:
+            # the report reads the injector's counters before this returns
+            return self._run_loop()
+        finally:
+            CHAOS.reset()
+
+    def _run_loop(self) -> SimRun:
         sc = self.scenario
         t_end = sc.start_s + sc.duration_s + sc.settle_s
         wall0 = time.perf_counter()

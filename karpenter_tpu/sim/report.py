@@ -133,6 +133,33 @@ def build_report(harness) -> Dict:
         # gate (every existing golden) carry no forecast section at all
         report["forecast"] = {k: forecast.stats[k]
                               for k in sorted(forecast.stats)}
+    if getattr(harness, "_chaos_enabled", False):
+        # present ONLY when the scenario armed the injector — same
+        # conditional contract as the forecast section, so every chaos-off
+        # report stays byte-identical.  Everything here is deterministic:
+        # injection counts come from the seeded schedule, supervisor and
+        # ladder totals from virtual-clock state machines.
+        from ..utils.chaos import CHAOS
+        sups = getattr(harness.mgr, "supervisors", {})
+        chaos_sec = {
+            "injections": CHAOS.counts(),
+            "injections_total": CHAOS.fired_total(),
+            "controller_failures": {
+                n: s.total_failures for n, s in sorted(sups.items())
+                if s.total_failures},
+            "controller_skips": {
+                n: s.total_skips for n, s in sorted(sups.items())
+                if s.total_skips},
+            "quarantines": {
+                n: s.total_quarantines for n, s in sorted(sups.items())
+                if s.total_quarantines},
+        }
+        prov = harness.mgr.controllers.get("provisioning")
+        health = getattr(prov, "health", None)
+        if health is not None:
+            chaos_sec["solver_transitions"] = dict(
+                sorted(health.transitions.items()))
+        report["chaos"] = chaos_sec
     return report
 
 

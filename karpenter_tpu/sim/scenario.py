@@ -210,6 +210,61 @@ class ForecastSpec:
 
 
 @dataclass
+class ChaosRuleSpec:
+    """One chaos fault stream (docs/simulation.md, utils/chaos.py).  Times
+    are scenario-relative seconds; the harness rebases them onto the
+    virtual clock before arming the injector.  `until_s: 0` means "until
+    the end of the run"."""
+    point: str
+    key: str = ""
+    action: str = "error"
+    rate: float = 1.0
+    at_s: float = 0.0
+    until_s: float = 0.0
+    latency_s: float = 0.0
+    count: int = 0
+    error_code: str = ""
+
+    def validate(self, ctx: str) -> None:
+        from ..utils.chaos import ACTIONS, POINTS
+        if self.point not in POINTS:
+            raise ScenarioError(f"{ctx}: unknown point {self.point!r} "
+                                f"(expected one of {sorted(POINTS)})")
+        if self.action not in ACTIONS:
+            raise ScenarioError(f"{ctx}: unknown action {self.action!r} "
+                                f"(expected one of {ACTIONS})")
+        if not 0.0 < self.rate <= 1.0:
+            raise ScenarioError(f"{ctx}: rate must be in (0, 1]")
+        if self.at_s < 0:
+            raise ScenarioError(f"{ctx}: at_s must be >= 0")
+        if self.until_s and self.until_s <= self.at_s:
+            raise ScenarioError(f"{ctx}: until_s must be > at_s (or 0 for "
+                                "open-ended)")
+        if self.action in ("latency", "hang") and self.latency_s <= 0:
+            raise ScenarioError(
+                f"{ctx}: {self.action} needs latency_s > 0")
+        if self.count < 0:
+            raise ScenarioError(f"{ctx}: count must be >= 0")
+
+
+@dataclass
+class ChaosSpec:
+    """Deterministic fault-injection schedule for a scenario.  `seed: null`
+    derives the chaos streams from the run seed, so `--seed` replays move
+    the whole schedule together; an explicit seed pins the schedule while
+    workload randomness still follows the run seed."""
+    enabled: bool = True
+    seed: Optional[int] = None
+    rules: List[ChaosRuleSpec] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if not self.rules:
+            raise ScenarioError("chaos: needs at least one rule")
+        for i, r in enumerate(self.rules):
+            r.validate(f"chaos.rules[{i}]")
+
+
+@dataclass
 class Scenario:
     name: str
     duration_s: float = 86_400.0
@@ -229,6 +284,8 @@ class Scenario:
     faults: List[Fault] = field(default_factory=list)
     # proactive headroom provisioning (None = Forecast gate stays off)
     forecast: Optional[ForecastSpec] = None
+    # deterministic fault injection (None = injector stays disarmed)
+    chaos: Optional[ChaosSpec] = None
 
     def validate(self) -> None:
         if not self.name:
@@ -250,6 +307,8 @@ class Scenario:
             f.validate()
         if self.forecast is not None:
             self.forecast.validate()
+        if self.chaos is not None:
+            self.chaos.validate()
         names = [w.name for w in self.workload]
         if len(set(names)) != len(names):
             raise ScenarioError(f"duplicate wave names: {names}")
@@ -286,6 +345,10 @@ _FORECAST_FIELDS = {
     "bucket_s": float, "confidence": float, "max_cost_frac": float,
     "model": str, "season_s": float,
 }
+_CHAOS_RULE_FIELDS = {
+    "point": str, "key": str, "action": str, "rate": float, "at_s": float,
+    "until_s": float, "latency_s": float, "count": int, "error_code": str,
+}
 
 
 def _coerce(ctx: str, doc: Dict, schema: Dict) -> Dict:
@@ -314,7 +377,7 @@ def scenario_from_dict(doc: Dict) -> Scenario:
         raise ScenarioError(f"scenario document must be a mapping, "
                             f"got {type(doc).__name__}")
     known = {"name", "zones", "intervals", "workload", "faults",
-             "forecast", *_SCENARIO_SCALARS}
+             "forecast", "chaos", *_SCENARIO_SCALARS}
     for key in doc:
         if key not in known:
             raise ScenarioError(f"unknown scenario field {key!r} "
@@ -364,6 +427,27 @@ def scenario_from_dict(doc: Dict) -> Scenario:
                 raise ScenarioError(f"forecast: unknown field {key!r}")
         kw["forecast"] = ForecastSpec(
             **_coerce("forecast", fdoc, _FORECAST_FIELDS))
+    if doc.get("chaos") is not None:
+        cdoc = doc["chaos"]
+        if not isinstance(cdoc, dict):
+            raise ScenarioError("chaos must be a mapping")
+        for key in cdoc:
+            if key not in ("enabled", "seed", "rules"):
+                raise ScenarioError(f"chaos: unknown field {key!r}")
+        rules = []
+        for i, r in enumerate(cdoc.get("rules", []) or []):
+            if not isinstance(r, dict):
+                raise ScenarioError(f"chaos.rules[{i}] must be a mapping")
+            for key in r:
+                if key not in _CHAOS_RULE_FIELDS:
+                    raise ScenarioError(
+                        f"chaos.rules[{i}]: unknown field {key!r}")
+            rules.append(ChaosRuleSpec(
+                **_coerce(f"chaos.rules[{i}]", r, _CHAOS_RULE_FIELDS)))
+        kw["chaos"] = ChaosSpec(
+            enabled=bool(cdoc.get("enabled", True)),
+            seed=None if cdoc.get("seed") is None else int(cdoc["seed"]),
+            rules=rules)
     sc = Scenario(**kw)
     sc.validate()
     return sc

@@ -1018,3 +1018,87 @@ def forecast_series_observations() -> Counter:
         "Demand-series observations ingested from the cluster observer "
         "hook, by kind (arrival | departure | bind).",
         labels=("kind",))
+
+
+# --- robustness: supervision, watchdogs, degradation, chaos ----------------
+
+def supervisor_state() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_supervisor_circuit_state",
+        "Per-controller supervisor circuit: 0=closed, 1=half_open, 2=open.",
+        labels=("controller",))
+
+
+def supervisor_consecutive_failures() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_supervisor_consecutive_failures",
+        "Consecutive reconcile failures per controller since last success.",
+        labels=("controller",))
+
+
+def supervisor_backoff_skips() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_supervisor_backoff_skips_total",
+        "Reconcile attempts skipped per controller while inside a "
+        "crash-loop backoff or open-circuit window.",
+        labels=("controller",))
+
+
+def supervisor_quarantines() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_supervisor_quarantines_total",
+        "Circuit-open events per controller (crash loop crossed the "
+        "consecutive-failure threshold).",
+        labels=("controller",))
+
+
+def watchdog_trips() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_watchdog_trips_total",
+        "Hard-deadline watchdog trips by guarded phase (the call was "
+        "abandoned and the degradation ladder notified).",
+        labels=("phase",))
+
+
+def degradation_transitions() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_degradation_transitions_total",
+        "Solver degradation-ladder transitions: demotions "
+        "(reason=timeout|error) and half-open recoveries "
+        "(reason=recovered, from==to).",
+        labels=("from", "to", "reason"))
+
+
+def degradation_rung() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_degradation_active_rung",
+        "Best currently-healthy solver rung as a ladder index "
+        "(0=sharded, 1=jax, 2=native, 3=greedy).")
+
+
+def cloud_retries() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_cloudprovider_retries_total",
+        "Cloud API retry attempts by method and outcome "
+        "(retried | recovered | exhausted).",
+        labels=("method", "outcome"))
+
+
+def cloud_breaker_state() -> Gauge:
+    return REGISTRY.gauge(
+        "karpenter_cloudprovider_circuit_state",
+        "Provider-level circuit breaker: 0=closed, 1=half_open, 2=open.")
+
+
+def cloud_breaker_opens() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_cloudprovider_circuit_opens_total",
+        "Provider circuit-breaker open events (error storm detected; "
+        "launches fast-fail into the ICE/backoff path for the cooldown).")
+
+
+def chaos_injections() -> Counter:
+    return REGISTRY.counter(
+        "karpenter_chaos_injections_total",
+        "Faults injected by the chaos harness, by point and action.",
+        labels=("point", "action"))

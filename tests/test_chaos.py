@@ -13,6 +13,14 @@ from karpenter_tpu.cloud.fake import (CloudError, ImageInfo,
                                       SecurityGroupInfo, SubnetInfo)
 from karpenter_tpu.operator import (ControllerManager, Operator, Options,
                                     build_controllers)
+from karpenter_tpu.utils.chaos import (CHAOS, ChaosError, ChaosInjector,
+                                       ChaosRule, parse_spec)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    CHAOS.reset()
 
 
 def pod(rng):
@@ -112,3 +120,143 @@ def test_all_offerings_blacklisted_then_recovery(stack):
     mgr.tick()
     assert not op.cluster.pending_pods()
     assert op.cloud.running()
+
+# ---------------------------------------------------------------------------
+# deterministic injector (utils/chaos.py): same seed => same schedule
+# ---------------------------------------------------------------------------
+
+def _drive(inj, n=200, t0=0.0):
+    """Call one rate-limited point n times on a stepping clock; return the
+    injection pattern as a bit-string."""
+    clock = [t0]
+    inj.configure(inj.rules, seed=inj._seed, clock=lambda: clock[0],
+                  sleep=lambda s: None)
+    bits = []
+    for _ in range(n):
+        clock[0] += 1.0
+        try:
+            inj.inject("cloud.api", key="create_fleet")
+            bits.append("0")
+        except (ChaosError, CloudError):
+            bits.append("1")
+    return "".join(bits)
+
+
+def test_same_seed_same_schedule():
+    a, b = ChaosInjector(), ChaosInjector()
+    rule = ChaosRule("cloud.api", key="create_fleet", rate=0.3)
+    for inj in (a, b):
+        inj.rules = [rule]
+        inj._seed = 42
+    pat_a, pat_b = _drive(a), _drive(b)
+    assert pat_a == pat_b
+    assert "1" in pat_a and "0" in pat_a      # rate actually partial
+    assert a.counts() == b.counts()
+    assert a.fired_total() == b.fired_total()
+
+
+def test_different_seed_different_schedule():
+    a, b = ChaosInjector(), ChaosInjector()
+    rule = ChaosRule("cloud.api", key="create_fleet", rate=0.3)
+    a.rules, a._seed = [rule], 1
+    b.rules, b._seed = [rule], 2
+    assert _drive(a) != _drive(b)
+
+
+def test_unmatched_calls_consume_no_rng():
+    """Only (point, key)-matching calls draw from a rule's stream, so
+    unrelated traffic cannot desync the schedule (the arena-on/off
+    golden-identity property)."""
+    a, b = ChaosInjector(), ChaosInjector()
+    rule = ChaosRule("cloud.api", key="create_fleet", rate=0.3)
+    a.rules, a._seed = [rule], 7
+    b.rules, b._seed = [rule], 7
+    clock = [0.0]
+    b.configure(b.rules, seed=7, clock=lambda: clock[0], sleep=lambda s: None)
+    for _ in range(50):  # noise on other points/keys before b's real run
+        b.inject("solver.pack", key="jax")
+        b.inject("cloud.api", key="describe_instances")
+    assert _drive(a) == _drive(b)
+
+
+def test_window_count_and_key_semantics():
+    inj = ChaosInjector()
+    clock = [0.0]
+    inj.configure([ChaosRule("solver.pack", key="jax", at_s=10.0,
+                             until_s=20.0, count=2)],
+                  seed=0, clock=lambda: clock[0], sleep=lambda s: None)
+    inj.inject("solver.pack", key="jax")       # t=0: before window
+    inj.inject("solver.pack", key="native")    # key mismatch
+    clock[0] = 10.0
+    with pytest.raises(ChaosError):
+        inj.inject("solver.pack", key="jax")   # window open
+    clock[0] = 15.0
+    with pytest.raises(ChaosError):
+        inj.inject("solver.pack", key="jax")
+    inj.inject("solver.pack", key="jax")       # count=2 exhausted
+    clock[0] = 25.0
+    inj.inject("solver.pack", key="jax")       # past until_s
+    assert inj.fired_total() == 2
+    assert inj.counts() == {"solver.pack/error": 2}
+
+
+def test_error_code_raises_cloud_error():
+    inj = ChaosInjector()
+    inj.configure([ChaosRule("cloud.api", key="create_fleet",
+                             error_code="RequestLimitExceeded")],
+                  seed=0, clock=lambda: 0.0, sleep=lambda s: None)
+    with pytest.raises(CloudError) as ei:
+        inj.inject("cloud.api", key="create_fleet")
+    assert ei.value.code == "RequestLimitExceeded"
+
+
+def test_latency_uses_injected_sleep_not_wall():
+    inj = ChaosInjector()
+    slept = []
+    inj.configure([ChaosRule("refinery.refine", action="latency",
+                             latency_s=2.5)],
+                  seed=0, clock=lambda: 0.0, sleep=slept.append)
+    inj.inject("refinery.refine")
+    assert slept == [2.5]
+
+
+def test_disabled_injector_is_inert():
+    inj = ChaosInjector()
+    assert not inj.enabled
+    inj.inject("solver.pack", key="jax")       # no-op, no validation cost
+    inj.configure([ChaosRule("solver.pack")], seed=0,
+                  clock=lambda: 0.0, sleep=lambda s: None)
+    assert inj.enabled
+    inj.reset()
+    assert not inj.enabled and not inj.rules
+    inj.inject("solver.pack", key="jax")       # disarmed again
+
+
+def test_configure_rejects_bad_rules():
+    inj = ChaosInjector()
+    with pytest.raises(ValueError, match="unknown point"):
+        inj.configure([ChaosRule("not.a.point")])
+    with pytest.raises(ValueError, match="unknown action"):
+        inj.configure([ChaosRule("solver.pack", action="explode")])
+    with pytest.raises(ValueError, match="rate"):
+        inj.configure([ChaosRule("solver.pack", rate=0.0)])
+    assert not inj.enabled
+
+
+def test_parse_spec_round_trip():
+    rules = parse_spec(
+        "point=controller.reconcile,key=disruption,action=error,rate=0.5;"
+        " point=cloud.api,action=latency,latency_s=0.2,count=3,"
+        "at_s=10,until_s=99,error_code=Throttling")
+    assert len(rules) == 2
+    r0, r1 = rules
+    assert (r0.point, r0.key, r0.action, r0.rate) == \
+        ("controller.reconcile", "disruption", "error", 0.5)
+    assert (r1.point, r1.action, r1.latency_s, r1.count) == \
+        ("cloud.api", "latency", 0.2, 3)
+    assert (r1.at_s, r1.until_s, r1.error_code) == (10.0, 99.0, "Throttling")
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_spec("point=cloud.api,bogus=1")
+    with pytest.raises(ValueError, match="needs point="):
+        parse_spec("action=error")
+    assert parse_spec("") == []

@@ -23,6 +23,7 @@ from karpenter_tpu.analysis.locks import LockDisciplineChecker
 from karpenter_tpu.analysis.lockorder import (
     LockOrderRecorder, _RecordingLock, named_lock)
 from karpenter_tpu.analysis.observability import ObservabilityChecker
+from karpenter_tpu.analysis.robustness import RobustnessChecker
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE = os.path.join(REPO, "tools", "graftlint-baseline.json")
@@ -589,7 +590,7 @@ def test_cli_json_and_list_rules():
 def test_default_checkers_cover_all_families():
     fams = {c.family for c in default_checkers()}
     assert fams == {"jax-hotpath", "determinism", "lock-discipline",
-                    "observability", "arena-discipline"}
+                    "observability", "arena-discipline", "robustness"}
 
 
 # ---------------------------------------------------------------------------
@@ -666,3 +667,95 @@ def test_arena_module_itself_is_clean():
             if sf.rel == "karpenter_tpu/ops/arena.py"]
     assert srcs, "ops/arena.py not found"
     assert _rules(ArenaDisciplineChecker().check_file(srcs[0])) == []
+
+
+# ---------------------------------------------------------------------------
+# robustness fixtures
+# ---------------------------------------------------------------------------
+
+def test_rs001_swallowed_reconcile_fault():
+    src = """
+        def tick(controllers):
+            for c in controllers:
+                try:
+                    c.reconcile()
+                except Exception:
+                    pass
+    """
+    out = RobustnessChecker().check_file(
+        _sf(src, "karpenter_tpu/controllers/x.py"))
+    assert _rules(out) == ["RS001"]
+
+
+def test_rs001_reraise_and_narrow_handlers_are_clean():
+    src = """
+        def tick(prov):
+            try:
+                prov.provision()
+            except Exception:
+                log.warning("boom")
+                raise
+            try:
+                prov.reconcile()
+            except ValueError:
+                pass
+    """
+    out = RobustnessChecker().check_file(
+        _sf(src, "karpenter_tpu/controllers/x.py"))
+    assert _rules(out) == []
+
+
+def test_rs001_manager_and_supervisor_are_exempt():
+    src = """
+        def _supervised(self, reconcile):
+            try:
+                reconcile.reconcile()
+            except Exception:
+                pass
+    """
+    out = RobustnessChecker().check_file(
+        _sf(src, "karpenter_tpu/operator/manager.py"))
+    assert _rules(out) == []
+
+
+def test_rs002_unregistered_chaos_point():
+    src = """
+        from karpenter_tpu.utils.chaos import CHAOS
+
+        def f():
+            CHAOS.inject("solver.pack", key="jax")
+            CHAOS.inject("made.up.point")
+    """
+    out = RobustnessChecker().check_file(
+        _sf(src, "karpenter_tpu/ops/x.py"))
+    assert _rules(out) == ["RS002"]
+    assert out[0].detail == "made.up.point"
+
+
+def test_rs003_unregistered_watchdog_phase():
+    src = """
+        from karpenter_tpu.utils.watchdog import run_with_deadline
+
+        def f(fn):
+            run_with_deadline(fn, 1.0, "provision.solve")
+            run_with_deadline(fn, 1.0, phase="disruption.simulate")
+            run_with_deadline(fn, 1.0, "bogus.phase")
+            run_with_deadline(fn, 1.0, phase="also.bogus")
+    """
+    out = RobustnessChecker().check_file(
+        _sf(src, "karpenter_tpu/controllers/x.py"))
+    assert _rules(out) == ["RS003", "RS003"]
+    assert sorted(f.detail for f in out) == ["also.bogus", "bogus.phase"]
+
+
+def test_rs_dynamic_names_are_not_flagged():
+    """Only literals participate in the closed-registry contract; computed
+    points/phases are runtime-checked by inject()/run_with_deadline()."""
+    src = """
+        def f(fn, point, phase):
+            CHAOS.inject(point)
+            run_with_deadline(fn, 1.0, phase)
+    """
+    out = RobustnessChecker().check_file(
+        _sf(src, "karpenter_tpu/controllers/x.py"))
+    assert _rules(out) == []

@@ -1,0 +1,282 @@
+"""Degradation ladder (ops/health.py) + watchdog (utils/watchdog.py):
+demotion/probe/promotion semantics under an injectable clock, hard
+deadlines for hung solver calls, and the end-to-end guarantee that a
+failing solver stack still produces a valid greedy plan every tick and
+promotes back once the fault clears (docs/robustness.md)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import ImageInfo, SecurityGroupInfo, SubnetInfo
+from karpenter_tpu.operator import (ControllerManager, Operator, Options,
+                                    build_controllers)
+from karpenter_tpu.ops.health import RUNGS, SolverHealth
+from karpenter_tpu.utils.chaos import CHAOS, ChaosRule
+from karpenter_tpu.utils.watchdog import (PHASES, WatchdogTimeout,
+                                          run_with_deadline)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    CHAOS.reset()
+
+
+def _ladder(clock, **kw):
+    return SolverHealth(clock=lambda: clock[0], **kw)
+
+
+# ---------------------------------------------------------------------------
+# ladder state machine
+# ---------------------------------------------------------------------------
+
+def test_rung_order_is_the_documented_ladder():
+    assert RUNGS == ("sharded", "jax", "native", "greedy")
+
+
+def test_demotes_after_consecutive_errors_not_one():
+    clock = [0.0]
+    h = _ladder(clock)
+    assert h.active_rung("jax") == "jax"
+    h.report_failure("jax", reason="error")
+    assert h.active_rung("jax") == "jax"       # one strike is not out
+    h.report_failure("jax", reason="error")
+    assert h.active_rung("jax") == "native"    # two consecutive: demoted
+    assert h.transitions == {"jax>native:error": 1}
+
+
+def test_success_resets_the_error_streak():
+    clock = [0.0]
+    h = _ladder(clock)
+    h.report_failure("jax", reason="error")
+    h.report_success("jax")
+    h.report_failure("jax", reason="error")
+    assert h.active_rung("jax") == "jax"       # streak broken by success
+
+
+def test_timeout_demotes_immediately():
+    clock = [0.0]
+    h = _ladder(clock)
+    h.report_failure("jax", reason="timeout")
+    assert h.active_rung("jax") == "native"
+    assert h.transitions == {"jax>native:timeout": 1}
+
+
+def test_greedy_is_the_undemotable_floor():
+    clock = [0.0]
+    h = _ladder(clock)
+    for _ in range(10):
+        h.report_failure("greedy", reason="timeout")
+    assert h.active_rung("greedy") == "greedy"
+    assert h.transitions == {}                 # floor failures never demote
+    assert h.snapshot()["rungs"]["greedy"]["total_failures"] == 10
+
+
+def test_window_doubles_per_consecutive_demotion_and_caps():
+    clock = [0.0]
+    h = _ladder(clock, window_s=60.0, window_max_s=600.0)
+    windows = []
+    for _ in range(6):
+        h.report_failure("jax", reason="timeout")
+        windows.append(h.snapshot()["rungs"]["jax"]["demoted_for_s"])
+        # expire the window, then fail the probe to re-demote
+        clock[0] += windows[-1] + 1.0
+        assert h.active_rung("jax") == "jax"   # half-open probe offered
+    assert windows == [60.0, 120.0, 240.0, 480.0, 600.0, 600.0]
+
+
+def test_probe_failure_redemotes_without_a_second_strike():
+    clock = [0.0]
+    h = _ladder(clock)
+    h.report_failure("jax", reason="timeout")
+    clock[0] += 61.0
+    assert h.active_rung("jax") == "jax"
+    assert h.snapshot()["rungs"]["jax"]["probing"]
+    h.report_failure("jax", reason="error")    # ONE failure during probe
+    assert h.active_rung("jax") == "native"    # straight back down
+    assert h.transitions["jax>native:error"] == 1
+
+
+def test_probe_success_promotes_and_records_recovery():
+    clock = [0.0]
+    h = _ladder(clock)
+    h.report_failure("jax", reason="timeout")
+    assert h.active_rung("jax") == "native"
+    clock[0] += 61.0
+    assert h.active_rung("jax") == "jax"       # expired window: probe
+    h.report_success("jax")
+    assert h.transitions["jax>jax:recovered"] == 1
+    snap = h.snapshot()["rungs"]["jax"]
+    assert not snap["demoted"] and not snap["probing"]
+    assert snap["consecutive_demotions"] == 0
+    # fully healthy again: the next demotion starts the window over
+    h.report_failure("jax", reason="timeout")
+    assert h.snapshot()["rungs"]["jax"]["demoted_for_s"] == 60.0
+
+
+def test_requested_rung_caps_the_ladder_top():
+    clock = [0.0]
+    h = _ladder(clock)
+    assert h.active_rung("sharded") == "sharded"
+    assert h.active_rung("native") == "native"
+    h.report_failure("native", reason="timeout")
+    assert h.active_rung("native") == "greedy"
+    assert h.active_rung("jax") == "jax"       # jax untouched by native's fall
+
+
+def test_two_identical_ladders_replay_identically():
+    a_clock, b_clock = [100.0], [100.0]
+    a, b = _ladder(a_clock), _ladder(b_clock)
+    script = [("fail", "jax", "error"), ("fail", "jax", "error"),
+              ("tick", 61.0), ("fail", "jax", "timeout"),
+              ("tick", 200.0), ("ok", "jax")]
+    for h, clock in ((a, a_clock), (b, b_clock)):
+        for step in script:
+            if step[0] == "tick":
+                clock[0] += step[1]
+                h.active_rung("jax")
+            elif step[0] == "fail":
+                h.report_failure(step[1], reason=step[2])
+            else:
+                h.report_success(step[1])
+    assert a.snapshot() == b.snapshot()
+    assert a.transitions == b.transitions
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_zero_timeout_is_a_direct_call():
+    calls = []
+    assert run_with_deadline(lambda: calls.append(1) or 42, 0.0,
+                             "provision.solve") == 42
+    assert run_with_deadline(lambda: 7, -1.0, "provision.solve") == 7
+
+
+def test_watchdog_passes_result_and_exception_through():
+    assert run_with_deadline(lambda: "ok", 5.0, "provision.solve") == "ok"
+    with pytest.raises(KeyError):
+        run_with_deadline(lambda: {}["missing"], 5.0, "disruption.simulate")
+
+
+def test_watchdog_trips_on_hang_and_abandons_the_worker():
+    release = threading.Event()
+    with pytest.raises(WatchdogTimeout) as ei:
+        run_with_deadline(lambda: release.wait(30.0), 0.05,
+                          "provision.solve")
+    assert ei.value.phase == "provision.solve"
+    assert ei.value.timeout_s == 0.05
+    release.set()  # unblock the abandoned daemon worker
+
+
+def test_watchdog_rejects_unregistered_phases():
+    with pytest.raises(ValueError, match="unregistered watchdog phase"):
+        run_with_deadline(lambda: 1, 0.0, "made.up.phase")
+    assert "provision.solve" in PHASES
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: failing solver stack still plans every tick, then recovers
+# ---------------------------------------------------------------------------
+
+def _pod(rng):
+    return Pod(requests=ResourceList({
+        CPU: int(rng.integers(200, 3000)),
+        MEMORY: int(rng.integers(256, 4096)) * 2**20}))
+
+
+@pytest.fixture
+def stack():
+    clock = [10_000.0]
+    op = Operator(Options(interruption_queue="q", batch_idle_duration=0.5),
+                  catalog=generate_catalog(25), clock=lambda: clock[0])
+    op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {}),
+                        SubnetInfo("s-b", "zone-b", 10_000, {})]
+    op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+    op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+    op.params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    mgr = ControllerManager(op, build_controllers(op), clock=lambda: clock[0])
+    return op, mgr, clock
+
+
+def _solve_tick(op, mgr, clock, rng, n=5):
+    """Add pods and tick until the batch window ripens and provisioning
+    solves (two ticks: observe, then ripe after the idle window)."""
+    op.cluster.add_pods([_pod(rng) for _ in range(n)])
+    mgr.tick()
+    clock[0] += 1.0
+    mgr.tick()
+
+
+def test_poisoned_upper_rungs_still_bind_pods_via_greedy(stack):
+    """Every device-path rung erroring: the ladder must walk down to the
+    NumPy greedy floor inside the same solve, bind all pods, record the
+    demotions, and promote back after the fault clears."""
+    op, mgr, clock = stack
+    rng = np.random.default_rng(3)
+    health = mgr.controllers["provisioning"].health
+    assert health is not None, "build_controllers must wire the ladder"
+    CHAOS.configure([ChaosRule("solver.pack", key="jax"),
+                     ChaosRule("solver.pack", key="native")],
+                    seed=0, clock=lambda: clock[0], sleep=lambda s: None)
+
+    _solve_tick(op, mgr, clock, rng)
+    assert not op.cluster.pending_pods(), "greedy floor failed to plan"
+    assert op.cloud.running()
+    # inside one solve: jax error, native error, greedy success — one
+    # strike each, no demotion yet
+    snap = health.snapshot()["rungs"]
+    assert snap["jax"]["total_failures"] == 1
+    assert snap["native"]["total_failures"] == 1
+
+    # second poisoned solve crosses demote_after=2 on both rungs
+    clock[0] += 30.0
+    _solve_tick(op, mgr, clock, rng)
+    assert not op.cluster.pending_pods()
+    assert health.transitions["jax>native:error"] == 1
+    assert health.transitions["native>greedy:error"] == 1
+
+    # third solve: demoted rungs are skipped, straight to greedy
+    clock[0] += 5.0
+    _solve_tick(op, mgr, clock, rng)
+    assert not op.cluster.pending_pods()
+    snap = health.snapshot()["rungs"]
+    assert snap["jax"]["total_failures"] == 2   # unchanged: not attempted
+
+    # fault clears; past the demotion window the probe promotes jax back
+    CHAOS.reset()
+    clock[0] += 120.0
+    _solve_tick(op, mgr, clock, rng)
+    assert not op.cluster.pending_pods()
+    assert health.transitions.get("jax>jax:recovered") == 1
+    assert not health.snapshot()["rungs"]["jax"]["demoted"]
+
+
+def test_happy_path_ladder_is_invisible(stack):
+    """With no chaos armed the wired ladder must not change behavior:
+    pods bind, no transitions, no failures booked."""
+    op, mgr, clock = stack
+    rng = np.random.default_rng(4)
+    health = mgr.controllers["provisioning"].health
+    _solve_tick(op, mgr, clock, rng)
+    assert not op.cluster.pending_pods()
+    assert health.transitions == {}
+    assert all(r["total_failures"] == 0
+               for r in health.snapshot()["rungs"].values())
+
+
+def test_health_snapshot_exposed_via_manager(stack):
+    op, mgr, clock = stack
+    rng = np.random.default_rng(5)
+    _solve_tick(op, mgr, clock, rng)
+    snap = mgr.health_snapshot()
+    assert "solver" in snap
+    assert set(snap["solver"]["rungs"]) == set(RUNGS)
+    assert snap["controllers"]["provisioning"]["state"] == "closed"

@@ -118,6 +118,40 @@ class TestDualCertificate:
                                         self._cnt, 2.0, self._pc, self._pj,
                                         self._x)
 
+    def test_scaled_duals_fail_strong_duality(self):
+        # A scipy release that rescaled marginals (not just flipped them)
+        # must also trip the certificate: y*2 doubles the reconstructed
+        # objective.
+        from karpenter_tpu.ops.lpguide import _dual_certificate_ok
+        assert not _dual_certificate_ok(2.0 * self._y, self._mu, self._reqf,
+                                        self._cnt, 2.0, self._pc, self._pj,
+                                        self._x)
+
+    def test_tolerance_is_objective_relative(self):
+        # The tol*scale normalization: a perturbation of absolute size 1
+        # is noise on a z=2e6 objective but a flipped convention on z=2.
+        from karpenter_tpu.ops.lpguide import _dual_certificate_ok
+        big = 1e6
+        assert _dual_certificate_ok(big * self._y + 0.25, big * self._mu,
+                                    self._reqf, self._cnt, big * 2.0 + 1.0,
+                                    self._pc, self._pj, self._x)
+        assert not _dual_certificate_ok(self._y + 0.25, self._mu,
+                                        self._reqf, self._cnt, 3.0,
+                                        self._pc, self._pj, self._x)
+
+    def test_empty_support_certifies_on_duality_alone(self):
+        # No basic pairs (all x at zero): complementary slackness is
+        # vacuous, so only strong duality is checked — even a flipped mu
+        # passes, and a broken y still fails.
+        from karpenter_tpu.ops.lpguide import _dual_certificate_ok
+        zeros = np.zeros_like(self._x)
+        assert _dual_certificate_ok(self._y, -self._mu, self._reqf,
+                                    self._cnt, 2.0, self._pc, self._pj,
+                                    zeros)
+        assert not _dual_certificate_ok(-self._y, -self._mu, self._reqf,
+                                        self._cnt, 2.0, self._pc, self._pj,
+                                        zeros)
+
     def test_real_lp_certifies(self):
         prob = tensorize(_blend_pods(), _catalog_2ratio(), [NodePool()])
         ok = _feasible_mask(prob)

@@ -125,6 +125,38 @@ def test_native_matches_jax_on_score_overflow():
     assert [n.option.instance_type for n in b.nodes] == ["big", "big"]
 
 
+def test_nan_priced_option_treated_as_unopenable_everywhere():
+    # NaN prices (a poisoned pricing feed) must behave exactly like inf:
+    # isfinite gates the open on every backend — including the numpy
+    # greedy rung, since the degradation ladder (ops/health.py) may route
+    # the SAME problem there mid-incident and the answer must not change.
+    catalog = [make_type("a.small", 2, 4, 0.10),
+               make_type("huge", 64, 256, float("nan"))]
+    pods = [cpu_pod(cpu_m=32000), cpu_pod(cpu_m=500)]
+    prob = tensorize(pods, catalog, [NodePool()])
+    a = native.solve_ffd_native(prob)
+    for backend in ("jax", "numpy"):
+        b = solve_ffd(prob, backend=backend)
+        assert_same_result(a, b)
+    assert sorted(a.unschedulable) == [0]
+    assert [n.option.instance_type for n in a.nodes] == ["a.small"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_inf_price_parity_across_all_backends(seed):
+    # Randomly poison ~40% of the catalog with inf prices: native, jax,
+    # and the numpy ladder floor must produce the identical plan, not
+    # merely plans of equal cost — ladder demotion must be invisible in
+    # the output.
+    rng = np.random.default_rng(seed)
+    prob = random_problem(seed, n_pods=40)
+    prob.option_price[rng.random(prob.option_price.shape[0]) < 0.4] = np.inf
+    a = native.solve_ffd_native(prob)
+    for backend in ("jax", "numpy"):
+        assert_same_result(a, solve_ffd(prob, backend=backend))
+    assert np.isfinite(a.total_price)
+
+
 def test_build_is_idempotent():
     assert native.build()
     assert native.build()

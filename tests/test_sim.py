@@ -242,6 +242,10 @@ GOLDEN_CASES = [
     # 100ms-cadence churn through the warm incremental arena; truncated
     # hard because each virtual second is ~10 consolidation sweeps
     ("steady-state-drip", "steady-state-drip.yaml", 300.0),
+    # deterministic fault injection: supervisor quarantine/recovery, paced
+    # launch retries, and a ladder demote/recover — the chaos report
+    # section is part of the golden
+    ("chaos-storm", "chaos-storm.yaml", 5400.0),
 ]
 
 

@@ -1,0 +1,264 @@
+"""Supervised reconcile: crash-loop backoff and circuit-breaking
+determinism under a virtual clock (operator/supervisor.py), plus the
+manager-level isolation contract — one crash-looping controller must not
+perturb any sibling's cadence (docs/robustness.md)."""
+
+import pytest
+
+from karpenter_tpu.operator.manager import ControllerManager
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.operator.supervisor import (
+    CLOSED, HALF_OPEN, OPEN, BackoffPolicy, ControllerSupervisor, _jitter)
+from karpenter_tpu.utils.events import Recorder
+
+
+# ---------------------------------------------------------------------------
+# backoff policy: deterministic, jittered, capped
+# ---------------------------------------------------------------------------
+
+def test_jitter_is_deterministic_and_bounded():
+    for name in ("disruption", "lifecycle", "provisioning"):
+        for failures in range(1, 12):
+            j = _jitter(name, failures)
+            assert j == _jitter(name, failures)  # pure function of inputs
+            assert 0.5 <= j < 1.0
+
+
+def test_jitter_decorrelates_controllers():
+    js = {_jitter(n, 3) for n in ("a", "b", "c", "disruption", "pricing")}
+    assert len(js) > 1, "every controller got the same jitter"
+
+
+def test_backoff_grows_exponentially_and_caps():
+    pol = BackoffPolicy(base_s=1.0, factor=2.0, max_s=300.0)
+    raw = [pol.delay("x", f) / _jitter("x", f) for f in range(1, 12)]
+    assert raw[0] == pytest.approx(1.0)
+    for a, b in zip(raw, raw[1:]):
+        assert b == pytest.approx(min(300.0, a * 2.0)) or b == 300.0
+    assert raw[-1] == pytest.approx(300.0)  # capped
+    # two policies with the same knobs replay identically
+    pol2 = BackoffPolicy(base_s=1.0, factor=2.0, max_s=300.0)
+    assert [pol.delay("d", f) for f in range(1, 9)] == \
+        [pol2.delay("d", f) for f in range(1, 9)]
+
+
+# ---------------------------------------------------------------------------
+# supervisor state machine under a virtual clock
+# ---------------------------------------------------------------------------
+
+def _sup(threshold=3, base=1.0):
+    return ControllerSupervisor(
+        "t", policy=BackoffPolicy(base_s=base, max_s=300.0),
+        circuit_threshold=threshold)
+
+
+def test_happy_path_is_invisible():
+    sup = _sup()
+    for now in (0.0, 5.0, 10.0):
+        assert sup.allow(now)
+        sup.record_success(now)
+    assert sup.state == CLOSED
+    assert sup.failures == 0 and sup.total_skips == 0
+    assert sup.next_allowed() == float("-inf")
+
+
+def test_failures_back_off_and_skips_do_not_advance():
+    sup = _sup(threshold=10)
+    sup.record_failure(100.0, RuntimeError("boom"))
+    assert sup.failures == 1
+    assert 100.5 <= sup.retry_at < 101.0  # base 1s * jitter [0.5, 1.0)
+    assert not sup.allow(sup.retry_at - 0.01)
+    assert sup.total_skips == 1
+    assert sup.allow(sup.retry_at)        # window expired
+    sup.record_failure(sup.retry_at, RuntimeError("boom"))
+    assert sup.failures == 2              # consecutive count grows
+    assert sup.next_allowed() == sup.retry_at
+
+
+def test_circuit_opens_at_threshold_probes_and_recovers():
+    sup = _sup(threshold=3)
+    now = 0.0
+    for _ in range(3):
+        now = max(now + 0.01, sup.retry_at)
+        assert sup.allow(now)
+        sup.record_failure(now, ValueError("bad"))
+    assert sup.state == OPEN
+    assert sup.total_quarantines == 1
+    assert sup.last_error == "ValueError: bad"
+    # inside the window: skipped, still open
+    assert not sup.allow(now + 0.01)
+    # past the window: half-open probe
+    now = sup.retry_at
+    assert sup.allow(now)
+    assert sup.state == HALF_OPEN
+    # failed probe goes straight back to open with a longer window
+    prev_delay = sup.retry_at - now
+    sup.record_failure(now, ValueError("still bad"))
+    assert sup.state == OPEN
+    assert sup.retry_at - now > prev_delay
+    # successful probe closes the circuit and resets everything
+    now = sup.retry_at
+    assert sup.allow(now)
+    sup.record_success(now)
+    assert sup.state == CLOSED
+    assert sup.failures == 0
+    assert sup.next_allowed() == float("-inf")
+
+
+def test_two_identical_supervisors_replay_identically():
+    a, b = _sup(threshold=4), _sup(threshold=4)
+    schedule = [(1.0, False), (3.0, False), (9.0, True), (20.0, False),
+                (40.0, False), (90.0, False), (200.0, True)]
+    for sup in (a, b):
+        for now, ok in schedule:
+            sup.allow(now)
+            if ok:
+                sup.record_success(now)
+            else:
+                sup.record_failure(now, RuntimeError("x"))
+    assert a.snapshot() == b.snapshot()
+
+
+def test_quarantine_publishes_recorder_event():
+    clock = [50.0]
+    rec = Recorder(clock=lambda: clock[0])
+    sup = ControllerSupervisor("disruption", circuit_threshold=2,
+                               recorder=rec)
+    sup.record_failure(50.0, RuntimeError("kaput"))
+    clock[0] = 60.0
+    sup.record_failure(60.0, RuntimeError("kaput"))
+    evs = rec.events(reason="Quarantined")
+    assert len(evs) == 1
+    assert evs[0].type == "Warning"
+    assert evs[0].name == "disruption"
+    assert "controller quarantined: RuntimeError: kaput" in evs[0].message
+
+
+def test_snapshot_shape():
+    sup = _sup()
+    sup.record_failure(5.0, KeyError("k"))
+    snap = sup.snapshot()
+    assert snap["state"] == CLOSED
+    assert snap["consecutive_failures"] == 1
+    assert snap["retry_at"] > 5.0
+    assert snap["last_error"].startswith("KeyError")
+    assert snap["total_failures"] == 1
+
+
+# ---------------------------------------------------------------------------
+# manager integration: isolation + cadence hold
+# ---------------------------------------------------------------------------
+
+class _Counting:
+    def __init__(self):
+        self.runs = 0
+
+    def reconcile(self):
+        self.runs += 1
+
+
+class _Crashing:
+    def __init__(self):
+        self.calls = 0
+
+    def reconcile(self):
+        self.calls += 1
+        raise RuntimeError("poisoned controller")
+
+
+class _FakeOperator:
+    """Just enough operator surface for ControllerManager."""
+
+    def __init__(self, clock):
+        self.options = Options(supervisor_circuit_threshold=5)
+        self.clock = clock
+        self.recorder = Recorder(clock=clock)
+        self.state_lock = None
+
+        class _NoPending:
+            @staticmethod
+            def pending_pods():
+                return []
+
+        self.cluster = _NoPending()
+        self.node_classes = {}
+
+
+def _mgr(controllers, clock):
+    return ControllerManager(_FakeOperator(clock), controllers,
+                             clock=clock)
+
+
+def test_crash_loop_does_not_steal_sibling_cadence():
+    """One crash-looping controller, everyone else on a 1s interval over
+    1000 virtual seconds: the healthy controllers must complete >=95% of
+    their expected reconciles while the poisoned one is quarantined and
+    backed off to a small attempt count."""
+    clock = [0.0]
+    healthy = {f"h{i}": _Counting() for i in range(3)}
+    bad = _Crashing()
+    mgr = _mgr({**healthy, "bad": bad}, lambda: clock[0])
+    for e in mgr._entries:
+        e.interval = 1.0
+    ticks = 1000
+    for _ in range(ticks):
+        clock[0] += 1.0
+        mgr.tick()
+    for name, ctrl in healthy.items():
+        assert ctrl.runs >= 0.95 * ticks, \
+            f"{name} starved: {ctrl.runs}/{ticks}"
+    # the poisoned controller was paced: exponential backoff means the
+    # attempt count is logarithmic-ish in the horizon, not linear
+    assert bad.calls < ticks * 0.1, f"crash loop not contained: {bad.calls}"
+    sup = mgr.supervisors["bad"]
+    assert sup.total_quarantines >= 1
+    assert sup.state in (OPEN, HALF_OPEN)
+    assert mgr.supervisors["h0"].failures == 0
+
+
+def test_cadence_resumes_immediately_after_recovery():
+    """`allow` skips must not advance last_run: the first tick after the
+    backoff window expires reconciles again."""
+    clock = [0.0]
+
+    class _FlakyOnce(_Crashing):
+        def reconcile(self):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("one bad tick")
+
+    flaky = _FlakyOnce()
+    mgr = _mgr({"flaky": flaky}, lambda: clock[0])
+    mgr._entries[0].interval = 10.0
+    clock[0] = 10.0
+    mgr.tick()                      # fails; backoff <= 1s
+    assert flaky.calls == 1
+    clock[0] = 20.0                 # next interval, window long expired
+    mgr.tick()
+    assert flaky.calls == 2         # cadence held, no extra wait
+    assert mgr.supervisors["flaky"].failures == 0
+
+
+def test_health_snapshot_surfaces_supervisors():
+    clock = [0.0]
+    mgr = _mgr({"bad": _Crashing(), "ok": _Counting()}, lambda: clock[0])
+    for e in mgr._entries:
+        e.interval = 1.0
+    for _ in range(3):
+        clock[0] += 1.0
+        mgr.tick()
+    snap = mgr.health_snapshot()
+    assert set(snap["controllers"]) == {"bad", "ok"}
+    assert snap["controllers"]["bad"]["total_failures"] >= 1
+    assert snap["controllers"]["ok"]["total_failures"] == 0
+    assert "solver" not in snap     # no provisioning controller wired
+
+
+def test_supervised_counts_reconcile_metrics_and_errors():
+    clock = [0.0]
+    mgr = _mgr({"bad": _Crashing()}, lambda: clock[0])
+    mgr._entries[0].interval = 1.0
+    clock[0] = 1.0
+    results = mgr.tick()
+    assert "bad" not in results     # failed reconcile yields no result
+    assert mgr.supervisors["bad"].total_failures == 1
