@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet benchmark-interruption trace-demo sim-demo chaos-smoke deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -49,6 +49,13 @@ sim-demo: ## Replay the 24h diurnal scenario on the virtual clock (docs/simulati
 chaos-smoke: ## Replay the chaos-storm scenario + run the chaos/supervisor/ladder suites (docs/robustness.md)
 	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/chaos-storm.yaml --seed 0 > /dev/null
 	$(PYTEST) tests/test_chaos.py tests/test_supervisor.py tests/test_health.py -q
+
+bench-soak: ## Full endurance soak: 10⁶ coalesced delta ticks, fails on p99/RSS drift or coalescing <100x (one JSON line)
+	python bench.py --soak
+
+soak-smoke: ## Truncated soak gate + the durability suites: snapshot/warm-restart, ingest batching, soak drift detector (docs/robustness.md)
+	JAX_PLATFORMS=cpu KARPENTER_TPU_SOAK_TICKS=1000 python bench.py --soak
+	$(PYTEST) tests/test_soak.py tests/test_snapshot.py tests/test_ingest.py -q
 
 deflake: ## Run the suite 5x to shake out order/timing flakes (Makefile:106-109)
 	for i in 1 2 3 4 5; do $(PYTEST) tests/ -q -p no:randomly || exit 1; done

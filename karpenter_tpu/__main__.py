@@ -23,6 +23,14 @@ def main(argv=None) -> int:
     configure_logging(options)
     op = Operator(options)
     manager = ControllerManager(op, build_controllers(op))
+    if options.gate("WarmRestart") and options.snapshot_path:
+        # warm restore AFTER construction: hydration already rebuilt what
+        # it could from cloud tags; a valid snapshot supersedes it with
+        # the full pre-crash working set (any mismatch falls back cold)
+        from .state.snapshot import restore_snapshot
+        with op.state_lock:
+            outcome = restore_snapshot(options.snapshot_path, op, manager)
+        logging.info("warm restart: %s", outcome)
     port = manager.serve_endpoints()
     logging.info("karpenter-tpu up: cluster=%s endpoints=127.0.0.1:%s "
                  "controllers=%s", options.cluster_name, port,

@@ -22,6 +22,18 @@ False``), `del` on such a chain, and in-place ndarray mutator calls
 (``.fill(...)``, ``.sort()``, ``.resize(...)``, ``.put(...)``) on one.
 Reads are out of scope — `gather()`'s fancy indexing copies, so reads
 can't corrupt the slab.
+
+The WarmRestart layer adds a third rule with a WIDER net on a NARROWER
+scope:
+
+  * AR003 — snapshot-path code (`state/snapshot.py`, `state/ingest.py`)
+    touching a slab attribute AT ALL (read or write), or a
+    `setattr`/`getattr` anywhere outside `ops/arena.py` whose name
+    argument is a slab-attr string literal.  Serialization is exactly
+    the place a generic ``for k, v in sections: setattr(arena, k, v)``
+    loop slips past AR001's lexical write detection — restore must flow
+    through ``ClusterArena.snapshot_state()/restore_state()`` so slab ⇄
+    registry consistency stays arena-owned.
 """
 
 from __future__ import annotations
@@ -42,8 +54,15 @@ rule("AR002", "arena-discipline",
      "annotate the def line with `# guarded-by: caller(state_lock)` (or "
      "`# graftlint: holds(<lock>)`) — every slab write happens under the "
      "operator's state lock")
+rule("AR003", "arena-discipline",
+     "snapshot-path code touches arena slab tensors directly",
+     "serialize/restore slabs only through ClusterArena.snapshot_state() "
+     "/ restore_state() — the snapshot layer must never read, write, or "
+     "setattr/getattr slab_* attributes itself")
 
 ARENA_MODULE = "karpenter_tpu/ops/arena.py"
+SNAPSHOT_MODULES = ("karpenter_tpu/state/snapshot.py",
+                    "karpenter_tpu/state/ingest.py")
 SLAB_ATTRS = frozenset({"slab_alloc", "slab_used", "slab_compat",
                         "slab_live"})
 _NDARRAY_MUTATORS = frozenset({"fill", "sort", "resize", "put"})
@@ -106,6 +125,34 @@ class ArenaDisciplineChecker(Checker):
                 f"{attr}:{kind}",
                 f"mutation of arena slab tensor {attr!r} ({kind}) outside "
                 f"the delta API ({ARENA_MODULE})"))
+        findings.extend(self._check_snapshot_path(sf))
+        return findings
+
+    def _check_snapshot_path(self, sf: SourceFile) -> List[Finding]:
+        """AR003: snapshot-path slab access + string-driven setattr/getattr
+        (the generic restore-loop escape hatch AR001's lexical write
+        detection cannot see)."""
+        findings: List[Finding] = []
+        snapshot_mod = sf.rel in SNAPSHOT_MODULES
+        for node in ast.walk(sf.tree):
+            if snapshot_mod and isinstance(node, ast.Attribute) and \
+                    node.attr in SLAB_ATTRS:
+                findings.append(Finding(
+                    "AR003", sf.rel, node.lineno, sf.scope_of(node),
+                    f"{node.attr}:access",
+                    f"snapshot-path access to slab tensor {node.attr!r} — "
+                    f"use ClusterArena.snapshot_state()/restore_state()"))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in ("setattr", "getattr") and \
+                    len(node.args) >= 2 and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    node.args[1].value in SLAB_ATTRS:
+                findings.append(Finding(
+                    "AR003", sf.rel, node.lineno, sf.scope_of(node),
+                    f"{node.args[1].value}:{node.func.id}",
+                    f"{node.func.id}() on slab tensor "
+                    f"{node.args[1].value!r} outside the delta API"))
         return findings
 
     def _check_arena_module(self, sf: SourceFile) -> List[Finding]:

@@ -13,7 +13,10 @@ kernels consume (missed donation = one extra device copy per solve).
 
 Detection is scoped to where the rule is meaningful: JH001/JH002 to the
 hot modules (`ops/`, `parallel/`), JH003/JH005/JH006 to jit-decorated
-functions anywhere, JH004 to any jit spec.
+functions anywhere, JH004 to any jit spec.  JH005 additionally covers
+CALL-FORM jit specs — `partial(jax.jit, ...)(fn)` and `jax.jit(fn, ...)`
+assignments (the `parallel/driver.py` init-slab wrappers) — by resolving
+`fn` to its same-file def and applying the same scratch-donation check.
 """
 
 from __future__ import annotations
@@ -114,12 +117,28 @@ def _static_names(call: ast.Call, fn: ast.FunctionDef) -> Set[str]:
     return out
 
 
+def _call_form_jit(node: ast.Call):
+    """(spec_call, wrapped_name) for call-form jit wrapping — `jax.jit(fn,
+    ...)` or `partial(jax.jit, ...)(fn)` — else None.  Decorator forms
+    never match: a decorator expression has no outer application call."""
+    if _is_jax_jit(node.func) and node.args and \
+            isinstance(node.args[0], ast.Name):
+        return node, node.args[0].id
+    if isinstance(node.func, ast.Call) and \
+            _jit_call_of(node.func) is not None and node.args and \
+            isinstance(node.args[0], ast.Name):
+        return node.func, node.args[0].id
+    return None
+
+
 class JaxHotPathChecker(Checker):
     family = "jax-hotpath"
 
     def check_file(self, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []
         hot = sf.rel.startswith(HOT_PREFIXES)
+        defs = {n.name: n for n in ast.walk(sf.tree)
+                if isinstance(n, ast.FunctionDef)}
         for node in ast.walk(sf.tree):
             # JH001/JH002: sync calls, anywhere in hot modules
             if isinstance(node, ast.Call) and \
@@ -145,12 +164,37 @@ class JaxHotPathChecker(Checker):
                                 sf.scope_of(node), kw.arg,
                                 f"non-literal {kw.arg} spec retraces "
                                 "per call"))
+            # JH005 on call-form specs: the wrapped fn resolves in-file
+            if isinstance(node, ast.Call):
+                cf = _call_form_jit(node)
+                if cf is not None and cf[1] in defs:
+                    findings.extend(self._check_donation(
+                        sf, defs[cf[1]], cf[0], node))
             # per-jit-function rules
             if isinstance(node, ast.FunctionDef):
                 spec = _is_jit_decorated(node)
                 if spec is not None:
                     findings.extend(self._check_jit_fn(sf, node, spec))
         return findings
+
+    def _check_donation(self, sf: SourceFile, fn: ast.FunctionDef,
+                        spec: ast.Call, site: ast.AST) -> List[Finding]:
+        """The JH005 scratch-donation check against an arbitrary spec call
+        (decorator or call form) over `fn`."""
+        static = _static_names(spec, fn)
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args +
+                  fn.args.kwonlyargs}
+        scratch = sorted(p for p in params - static
+                         if p.startswith("init_"))
+        if not scratch or any(kw.arg in ("donate_argnums",
+                                         "donate_argnames")
+                              for kw in spec.keywords):
+            return []
+        return [Finding(
+            "JH005", sf.rel, site.lineno, sf.scope_of(site),
+            f"{fn.name}:{','.join(scratch)}",
+            f"jit spec over {fn.name} consumes scratch buffers "
+            f"{scratch} without donation")]
 
     def _check_jit_fn(self, sf: SourceFile, fn: ast.FunctionDef,
                       spec: ast.Call) -> List[Finding]:

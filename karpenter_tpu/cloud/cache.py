@@ -127,3 +127,22 @@ class UnavailableOfferings:
         with self._lock:
             self._seq += 1
         self._cache.flush()
+
+    # ---- warm restart (state/snapshot.py) ---------------------------------
+    def snapshot_state(self) -> Dict:
+        """Round-trippable export: raw entries with absolute expiry stamps
+        plus the sequence number.  Entries whose TTL lapsed while the
+        operator was down simply read as expired after restore — the
+        purge-on-read path counts them as availability changes as usual."""
+        with self._cache._lock:
+            data = dict(self._cache._data)
+        with self._lock:
+            seq = self._seq
+        return {"entries": data, "seq": seq}
+
+    def restore_state(self, data: Dict) -> None:
+        with self._cache._lock:
+            self._cache._data.clear()
+            self._cache._data.update(data["entries"])
+        with self._lock:
+            self._seq = int(data["seq"])

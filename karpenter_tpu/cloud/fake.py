@@ -411,3 +411,45 @@ class FakeCloud:
 
     def running(self) -> List[CloudInstance]:
         return self.describe_instances()
+
+    # ---- warm restart (state/snapshot.py) ----
+    def snapshot_state(self) -> Dict:
+        """Round-trippable export of the whole fake-cloud world — the
+        kill-9 parity test replays the exact same launches after restore,
+        so instance/sequence counters transfer via probe-and-reset (read
+        the next value, recreate the counter at it: net zero draws)."""
+        with self._lock:
+            next_id = next(self._ids)
+            self._ids = itertools.count(next_id)
+            next_seq = next(self._sched_seq)
+            self._sched_seq = itertools.count(next_seq)
+            return {
+                "instances": dict(self._instances),
+                "ice_pools": set(self.insufficient_capacity_pools),
+                "calls": dict(self.calls),
+                "subnets": list(self.subnets),
+                "security_groups": list(self.security_groups),
+                "images": list(self.images),
+                "launch_templates": dict(self.launch_templates),
+                "spot_prices": dict(self.spot_prices),
+                "scheduled": list(self._scheduled),
+                "throttle_until": self.throttle_until,
+                "next_id": next_id,
+                "next_sched_seq": next_seq,
+            }
+
+    def restore_state(self, data: Dict) -> None:
+        with self._lock:
+            self._instances = dict(data["instances"])
+            self.insufficient_capacity_pools = set(data["ice_pools"])
+            self.calls = dict(data["calls"])
+            self.subnets = list(data["subnets"])
+            self.security_groups = list(data["security_groups"])
+            self.images = list(data["images"])
+            self.launch_templates = dict(data["launch_templates"])
+            self.spot_prices = dict(data["spot_prices"])
+            self._scheduled = list(data["scheduled"])
+            heapq.heapify(self._scheduled)
+            self.throttle_until = float(data["throttle_until"])
+            self._ids = itertools.count(int(data["next_id"]))
+            self._sched_seq = itertools.count(int(data["next_sched_seq"]))

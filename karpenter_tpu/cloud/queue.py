@@ -145,3 +145,16 @@ class FakeQueue:
     def __len__(self):
         with self._lock:
             return len(self._messages)
+
+    # ---- warm restart (state/snapshot.py) ----
+    def snapshot_state(self) -> Dict:
+        with self._lock:
+            return {"messages": list(self._messages),
+                    "inflight": dict(self._inflight),
+                    "sent_count": self.sent_count}
+
+    def restore_state(self, data: Dict) -> None:
+        with self._lock:
+            self._messages = list(data["messages"])
+            self._inflight = dict(data["inflight"])
+            self.sent_count = int(data["sent_count"])

@@ -166,3 +166,25 @@ class DemandSeries:
         if not self._bind_latency:
             return 0.0
         return sum(self._bind_latency) / len(self._bind_latency)
+
+    # ------------------------------------------------------------------
+    # warm restart (state/snapshot.py)
+    # ------------------------------------------------------------------
+    def snapshot_state(self) -> Dict:
+        """Plain-data export of the whole observation state — rings as
+        lists (deque maxlen re-applies on restore)."""
+        return {
+            "live": dict(self._live),
+            "ring": {cls: list(ring) for cls, ring in self._ring.items()},
+            "req": {cls: list(v) for cls, v in self._req.items()},
+            "bind_latency": list(self._bind_latency),
+            "bucket_end": self._bucket_end,
+        }
+
+    def restore_state(self, data: Dict) -> None:
+        self._live = dict(data["live"])
+        self._ring = {cls: deque(vals, maxlen=self.capacity)
+                      for cls, vals in data["ring"].items()}
+        self._req = {cls: list(v) for cls, v in data["req"].items()}
+        self._bind_latency = deque(data["bind_latency"], maxlen=256)
+        self._bucket_end = data["bucket_end"]

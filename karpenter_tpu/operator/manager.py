@@ -213,6 +213,16 @@ class ControllerManager:
         from ..analysis.lockorder import named_lock
         self._state_lock = getattr(operator, "state_lock", None) or \
             named_lock("state")
+        # warm-restart snapshot cadence (state/snapshot.py): written from
+        # inside the tick (under the state lock) and once more on stop()
+        self._snapshotter = None
+        if operator.options.gate("WarmRestart") and \
+                getattr(operator.options, "snapshot_path", ""):
+            from ..state.snapshot import SnapshotWriter
+            self._snapshotter = SnapshotWriter(
+                operator.options.snapshot_path, operator, manager=self,
+                interval_s=getattr(operator.options,
+                                   "snapshot_interval_s", 30.0))
 
     def _nodeclass_tick(self, ctrl):
         def run():
@@ -235,6 +245,11 @@ class ControllerManager:
     def _tick_locked(self) -> Dict[str, object]:
         now = self.clock()
         results: Dict[str, object] = {}
+        # IngestBatch: the window of events absorbed since the last tick
+        # lands as ONE arena delta before any controller reads the slab
+        arena = getattr(self.operator.cluster, "arena", None)
+        if arena is not None and hasattr(arena, "flush"):
+            arena.flush()
         prov = self.controllers.get("provisioning")
         if prov is not None:
             pending = len(self.operator.cluster.pending_pods())
@@ -270,6 +285,8 @@ class ControllerManager:
                           # resumes the moment the supervisor re-allows
             e.last_run = now
             self._supervised(now, e.name, e.reconcile, results)
+        if self._snapshotter is not None:
+            self._snapshotter.maybe_write(now)
         return results
 
     def _supervised(self, now: float, name: str,
@@ -325,6 +342,11 @@ class ControllerManager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._snapshotter is not None:
+            # SIGTERM hook: one final snapshot so the successor resumes
+            # from the moment of shutdown, not the last cadence tick
+            with self._state_lock:
+                self._snapshotter.write_final()
         if self._http is not None:
             self._http.shutdown()
         refinery = getattr(self.controllers.get("provisioning"), "refinery",

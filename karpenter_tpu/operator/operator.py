@@ -130,6 +130,15 @@ class Operator:
             # attach BEFORE hydration so restart recovery streams through
             # the delta API and the first tick gathers warm
             self.cluster.attach_arena()
+            if self.options.gate("IngestBatch"):
+                # wrap the arena behind the same delta surface: events
+                # coalesce per node between ticks, the manager flushes
+                # them as ONE delta at the top of each tick
+                from ..state.ingest import IngestBatcher
+                self.cluster.arena = IngestBatcher(
+                    self.cluster.arena,
+                    max_events=int(getattr(self.options,
+                                           "ingest_max_events", 100_000)))
         # one state lock shared by the tick loop (ControllerManager), the
         # /v1 surface, and the metrics collector — scrapes and solves must
         # never iterate cluster state mid-mutation (advisor r4)

@@ -74,11 +74,23 @@ class Options:
     # — off by default (it is a no-op on <2 devices and the partition
     # planner falls back whenever the batch has no zone structure); enable
     # with --sharded-solve or --feature-gates ShardedSolve=true.
+    # WarmRestart: periodic + SIGTERM state snapshots (state/snapshot.py)
+    # and a restore path that resumes reconcile without re-tensorizing the
+    # world — off by default; enable with --warm-restart or --feature-gates
+    # WarmRestart=true (requires --snapshot-path).  Knobs below
+    # (docs/robustness.md "durability & restart").
+    # IngestBatch: coalesce bind/reclaim/price/offering events between
+    # ticks (state/ingest.py) so a 50k-events/s firehose costs one arena
+    # delta application per tick — off by default; enable with
+    # --ingest-batch or --feature-gates IngestBatch=true.  Overflow past
+    # --ingest-max-events degrades to a full rebuild, never drops events.
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
                                  "LPRefinery": False, "Forecast": False,
                                  "IncrementalArena": True,
-                                 "ShardedSolve": False})
+                                 "ShardedSolve": False,
+                                 "WarmRestart": False,
+                                 "IngestBatch": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -105,6 +117,10 @@ class Options:
     cloud_breaker_cooldown_s: float = 30.0  # open-circuit fast-fail window
     chaos_spec: str = ""                    # utils/chaos.py rule DSL (off)
     chaos_seed: int = 0                     # chaos schedule seed
+    # durability knobs (WarmRestart / IngestBatch gates, docs/robustness.md)
+    snapshot_path: str = ""                 # snapshot file ("" = disabled)
+    snapshot_interval_s: float = 30.0       # cadence between snapshots
+    ingest_max_events: int = 100_000        # pending cap → rebuild degrade
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
@@ -230,6 +246,28 @@ class Options:
         p.add_argument("--chaos-seed", type=int,
                        default=env.get("chaos_seed", 0),
                        help="seed for the deterministic chaos schedule")
+        p.add_argument("--warm-restart", action="store_true", default=False,
+                       help="snapshot operator state on a cadence + SIGTERM "
+                            "and warm-restore on startup (shorthand for "
+                            "--feature-gates WarmRestart=true; needs "
+                            "--snapshot-path)")
+        p.add_argument("--ingest-batch", action="store_true", default=False,
+                       help="coalesce cluster events between ticks into one "
+                            "arena delta application (shorthand for "
+                            "--feature-gates IngestBatch=true)")
+        p.add_argument("--snapshot-path",
+                       default=env.get("snapshot_path", ""),
+                       help="state snapshot file for WarmRestart "
+                            "(empty disables snapshotting)")
+        p.add_argument("--snapshot-interval", type=float,
+                       dest="snapshot_interval_s",
+                       default=env.get("snapshot_interval_s", 30.0),
+                       help="seconds between periodic state snapshots")
+        p.add_argument("--ingest-max-events", type=int,
+                       default=env.get("ingest_max_events", 100_000),
+                       help="pending coalesced events before the batcher "
+                            "degrades to a full arena rebuild (never "
+                            "drops events)")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -265,6 +303,9 @@ class Options:
             cloud_breaker_cooldown_s=ns.cloud_breaker_cooldown_s,
             chaos_spec=ns.chaos_spec,
             chaos_seed=ns.chaos_seed,
+            snapshot_path=ns.snapshot_path,
+            snapshot_interval_s=ns.snapshot_interval_s,
+            ingest_max_events=ns.ingest_max_events,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
@@ -278,6 +319,10 @@ class Options:
             opts.feature_gates["IncrementalArena"] = True
         if ns.sharded_solve:
             opts.feature_gates["ShardedSolve"] = True
+        if ns.warm_restart:
+            opts.feature_gates["WarmRestart"] = True
+        if ns.ingest_batch:
+            opts.feature_gates["IngestBatch"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts
@@ -314,6 +359,8 @@ class Options:
             "cloud_breaker_threshold": int,
             "cloud_breaker_cooldown_s": float,
             "chaos_seed": int,
+            "snapshot_interval_s": float,
+            "ingest_max_events": int,
         }
         for f in fields(Options):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())

@@ -282,6 +282,109 @@ class ClusterArena:
         self._needs_rebuild = True
         self._note_delta("invalidate")
 
+    def apply_ingest_flush(self, touched: Sequence[Node] = (),  # guarded-by: caller(state_lock)
+                           removed: Sequence[str] = (),
+                           used_names: Sequence[str] = ()):
+        """Apply one tick's worth of coalesced ingestion events in a single
+        delta (the `IngestBatch` gate's flush path).  Rows re-derive through
+        the same exact math as the eager API — a batched flush and the
+        equivalent eager event stream differ only in slot layout, never in
+        gather() output (which orders by cluster dict, not slot).  Removals
+        run first so their slots recycle for same-tick adds."""
+        with tracing.span("arena.ingest_flush"):
+            for name in removed:
+                slot = self._slot_of.pop(name, None)
+                if slot is None:
+                    continue
+                self.slab_live[slot] = False
+                self._node_at[slot] = None
+                self._free.append(slot)
+            for node in touched:
+                slot = self._slot_of.get(node.name)
+                if slot is None:
+                    if self._free:
+                        slot = self._free.pop()
+                    else:
+                        slot = self._top
+                        self._top += 1
+                        self._grow_slots(self._top)
+                    self._slot_of[node.name] = slot
+                self._node_at[slot] = node
+                self.slab_live[slot] = True
+                self._fill_row(slot, node)
+            for name in used_names:
+                self._refresh_used(name)
+            self._note_delta("ingest_flush")
+            if len(self._free) > max(self.compact_floor, self.live_count):
+                self.compact()
+
+    # ---- snapshot / warm restart ------------------------------------------
+    def snapshot_state(self) -> Dict:  # guarded-by: caller(state_lock)
+        """Plain-data export of the whole slab + registries for the
+        WarmRestart snapshot (state/snapshot.py).  Arrays are copied so the
+        serializer can run concurrently with nothing — the caller holds the
+        state lock for the duration either way.  Node objects are NOT
+        exported (slots rewire by name on restore); rep Pods are, because
+        their class keys are content tuples that survive pickling."""
+        return {
+            "axes": tuple(self._axes),
+            "scales": dict(self._scales),
+            "slab_alloc": self.slab_alloc.copy(),
+            "slab_used": self.slab_used.copy(),
+            "slab_compat": self.slab_compat.copy(),
+            "slab_live": self.slab_live.copy(),
+            "slot_of": dict(self._slot_of),
+            "free": list(self._free),
+            "top": self._top,
+            "rid_of": dict(self._rid_of),
+            "reps": list(self._reps),
+            "epoch": self.epoch,
+            "compactions": self.compactions,
+            "needs_rebuild": self._needs_rebuild,
+        }
+
+    def restore_state(self, data: Dict) -> bool:  # guarded-by: caller(state_lock)
+        """Adopt a `snapshot_state` export, rewiring every slot to the
+        restored Cluster's node objects by name.  Returns False (leaving the
+        arena flagged for rebuild) when the snapshot can't be trusted: axis/
+        scale mismatch, or a tracked name the cluster no longer has — the
+        caller falls back to `rebuild()`, the always-correct path."""
+        if tuple(data["axes"]) != self._axes or \
+                dict(data["scales"]) != self._scales:
+            return False
+        nodes = self._cluster.nodes
+        slot_of: Dict[str, int] = dict(data["slot_of"])
+        if any(name not in nodes for name in slot_of):
+            return False
+        alloc = np.asarray(data["slab_alloc"], np.float32)
+        used = np.asarray(data["slab_used"], np.float32)
+        compat = np.asarray(data["slab_compat"], bool)
+        live = np.asarray(data["slab_live"], bool)
+        cap = alloc.shape[0]
+        if used.shape != alloc.shape or compat.shape[0] != cap or \
+                live.shape[0] != cap or alloc.shape[1] != len(self._axes):
+            return False
+        node_at: List[Optional[Node]] = [None] * cap
+        for name, slot in slot_of.items():
+            if not (0 <= slot < cap):
+                return False
+            node_at[slot] = nodes[name]
+        self.slab_alloc = alloc
+        self.slab_used = used
+        self.slab_compat = compat
+        self.slab_live = live
+        self._slot_of = slot_of
+        self._node_at = node_at
+        self._free = list(data["free"])
+        self._top = int(data["top"])
+        self._rid_of = dict(data["rid_of"])
+        self._reps = list(data["reps"])
+        self.epoch = int(data["epoch"])
+        self.compactions = int(data["compactions"])
+        self._needs_rebuild = bool(data["needs_rebuild"])
+        self._note_delta("restore")
+        return True
+
     # ---- compaction / rebuild ---------------------------------------------
     def compact(self):  # guarded-by: caller(state_lock)
         """Densify the slab: move live rows to the front in cluster dict

@@ -147,6 +147,40 @@ class SolverHealth:
                 metrics.degradation_rung().set(i)
                 return
 
+    # ---- warm restart (state/snapshot.py) ----------------------------
+    def snapshot_state(self) -> Dict:
+        """Round-trippable export of the whole ladder for the WarmRestart
+        snapshot.  `demoted_until` values are absolute clock readings, so
+        they only transfer between processes sharing a clock domain (the
+        sim's virtual clock, or a wall-clock restart where stale windows
+        simply read as expired)."""
+        return {
+            "rungs": {
+                rung: {
+                    "failures": st.failures,
+                    "demotions": st.demotions,
+                    "demoted_until": st.demoted_until,
+                    "probing": st.probing,
+                    "total_failures": st.total_failures,
+                    "total_demotions": st.total_demotions,
+                } for rung, st in self._state.items()
+            },
+            "transitions": dict(self.transitions),
+        }
+
+    def restore_state(self, data: Dict) -> None:
+        for rung, st in data["rungs"].items():
+            if rung not in self._state:
+                continue
+            cur = self._state[rung]
+            cur.failures = int(st["failures"])
+            cur.demotions = int(st["demotions"])
+            cur.demoted_until = float(st["demoted_until"])
+            cur.probing = bool(st["probing"])
+            cur.total_failures = int(st["total_failures"])
+            cur.total_demotions = int(st["total_demotions"])
+        self.transitions = dict(data["transitions"])
+
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict:
         """Deterministic ladder state for /debug/health and tests."""

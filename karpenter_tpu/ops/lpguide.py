@@ -325,6 +325,28 @@ def _cache_put(cache: dict, cache_max: int, key, value) -> None:
         cache[key] = value
 
 
+def snapshot_caches() -> dict:
+    """Plain-data export of the mix/stale/support caches for the
+    WarmRestart snapshot (state/snapshot.py) — keys are content digests,
+    values numpy arrays and scalars, all picklable.  Stale-entry stamps
+    transfer as-is: they only matter inside one clock domain (the sim's
+    virtual clock, or a same-boot restart); a cross-domain stamp just
+    fails the staleness window and the entry recomputes."""
+    with _MIX_LOCK:
+        return {"mix": dict(_MIX_CACHE), "stale": dict(_STALE_CACHE),
+                "support": dict(_SUPPORT_CACHE)}
+
+
+def restore_caches(data: dict) -> None:
+    with _MIX_LOCK:
+        _MIX_CACHE.clear()
+        _MIX_CACHE.update(data.get("mix", {}))
+        _STALE_CACHE.clear()
+        _STALE_CACHE.update(data.get("stale", {}))
+        _SUPPORT_CACHE.clear()
+        _SUPPORT_CACHE.update(data.get("support", {}))
+
+
 def _mix_keys(problem: Problem, caps: np.ndarray, max_nodes: int):
     """Content digests at three granularities over the RAW inputs (the
     feasibility mask is a deterministic — and, at 50k scale, ~150ms —

@@ -277,50 +277,54 @@ def solve_partitioned(problem: Problem, mesh: Optional[Mesh] = None,
                  else _partitioned_assign_donate)
     with tracing.span("shard.solve") as sp:
         sp.annotate(shards=n, classes_per_shard=Cs, slots=K, pods=Ppad)
-        out = assign_fn(
-            jnp.asarray(requests_sh.reshape(*shape, Cpad, R)),
-            jnp.asarray(counts_sh.reshape(*shape, Cpad)),
-            jnp.asarray(compat_packed.reshape(*shape,
-                                              *compat_packed.shape[1:])),
-            jnp.asarray(node_cap_sh.reshape(*shape, Cpad)),
-            jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank),
-            jnp.asarray(init_opt.reshape(*shape, K)),
-            jnp.asarray(init_used.reshape(*shape, K, R)),
-            K, Ppad, mesh)
-        assignment, slot_option, _unsched = jax.device_get(out)
-    assignment = np.asarray(assignment).reshape(n, Ppad).astype(np.int32)
-    slot_option = np.asarray(slot_option).reshape(n, K)
+        with tracing.span("shard.tensorize"):
+            staged = (
+                jnp.asarray(requests_sh.reshape(*shape, Cpad, R)),
+                jnp.asarray(counts_sh.reshape(*shape, Cpad)),
+                jnp.asarray(compat_packed.reshape(*shape,
+                                                  *compat_packed.shape[1:])),
+                jnp.asarray(node_cap_sh.reshape(*shape, Cpad)),
+                jnp.asarray(alloc), jnp.asarray(price), jnp.asarray(rank),
+                jnp.asarray(init_opt.reshape(*shape, K)),
+                jnp.asarray(init_used.reshape(*shape, K, R)))
+        with tracing.span("shard.kernel"):
+            out = assign_fn(*staged, K, Ppad, mesh)
+            assignment, slot_option, _unsched = jax.device_get(out)
 
     # host decode: per-shard pod ids from whole-class membership (a class
     # lives entirely on its shard), then the shared assembly
     from ..ops.ffd import PackingResult
-    members_arr = problem.members_arrays()
-    pod_parts, cls_parts, slot_parts = [], [], []
-    for s in range(n):
-        P_s = int(counts_sh[s].sum())
-        if P_s == 0:
-            continue
-        chunks, cls_ids = [], []
-        for pos, ci in enumerate(shard_cls[s]):
-            k = int(counts_sh[s, pos])
-            if k == 0:
+    with tracing.span("shard.assemble"):
+        assignment = np.asarray(assignment).reshape(n, Ppad).astype(np.int32)
+        slot_option = np.asarray(slot_option).reshape(n, K)
+        members_arr = problem.members_arrays()
+        pod_parts, cls_parts, slot_parts = [], [], []
+        for s in range(n):
+            P_s = int(counts_sh[s].sum())
+            if P_s == 0:
                 continue
-            chunks.append(members_arr[ci][:k])
-            cls_ids.append(np.full(k, ci, np.int64))
-        pod_s = np.concatenate(chunks)
-        a_s = assignment[s, :P_s]
-        slot_parts.append(
-            np.where(a_s >= 0, a_s.astype(np.int64) + s * K, -1))
-        pod_parts.append(pod_s)
-        cls_parts.append(np.concatenate(cls_ids))
-    if pod_parts:
-        result, used_add = _assemble_plan(
-            problem, np.concatenate(pod_parts), np.concatenate(cls_parts),
-            np.concatenate(slot_parts), slot_option, O, K)
-    else:
-        result, used_add = PackingResult(
-            nodes=[], unschedulable=[], existing_assignments={},
-            total_price=0.0), {}
+            chunks, cls_ids = [], []
+            for pos, ci in enumerate(shard_cls[s]):
+                k = int(counts_sh[s, pos])
+                if k == 0:
+                    continue
+                chunks.append(members_arr[ci][:k])
+                cls_ids.append(np.full(k, ci, np.int64))
+            pod_s = np.concatenate(chunks)
+            a_s = assignment[s, :P_s]
+            slot_parts.append(
+                np.where(a_s >= 0, a_s.astype(np.int64) + s * K, -1))
+            pod_parts.append(pod_s)
+            cls_parts.append(np.concatenate(cls_ids))
+        if pod_parts:
+            result, used_add = _assemble_plan(
+                problem, np.concatenate(pod_parts),
+                np.concatenate(cls_parts),
+                np.concatenate(slot_parts), slot_option, O, K)
+        else:
+            result, used_add = PackingResult(
+                nodes=[], unschedulable=[], existing_assignments={},
+                total_price=0.0), {}
     metrics.shard_solve_duration().observe(time.perf_counter() - t1,
                                            {"phase": "solve"})
 

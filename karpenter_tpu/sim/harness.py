@@ -102,7 +102,9 @@ class SimHarness:
                  duration_s: Optional[float] = None,
                  forecast: Optional[bool] = None,
                  incremental_arena: Optional[bool] = None,
-                 sharded_solve: Optional[bool] = None):
+                 sharded_solve: Optional[bool] = None,
+                 warm_restart: Optional[bool] = None,
+                 ingest_batch: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
         twice — knobs still come from the scenario's forecast block.
@@ -110,7 +112,10 @@ class SimHarness:
         (default on): False replays the exact pre-arena full-rebuild code
         paths, the golden byte-identity escape hatch.  `sharded_solve`
         overrides the ShardedSolve gate (default off): goldens are recorded
-        with the gate off, so the default replay stays byte-identical."""
+        with the gate off, so the default replay stays byte-identical.
+        `warm_restart` / `ingest_batch` override the WarmRestart and
+        IngestBatch gates (both default off) for the durability tests —
+        goldens are recorded with both off."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -135,6 +140,10 @@ class SimHarness:
             opts.feature_gates["IncrementalArena"] = bool(incremental_arena)
         if sharded_solve is not None:
             opts.feature_gates["ShardedSolve"] = bool(sharded_solve)
+        if warm_restart is not None:
+            opts.feature_gates["WarmRestart"] = bool(warm_restart)
+        if ingest_batch is not None:
+            opts.feature_gates["IngestBatch"] = bool(ingest_batch)
         fc = scenario.forecast
         fc_on = forecast if forecast is not None \
             else (fc is not None and fc.enabled)

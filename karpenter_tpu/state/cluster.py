@@ -66,6 +66,32 @@ class Cluster:
         self.arena.rebuild()
         return self.arena
 
+    # ---- warm restart (state/snapshot.py) ----
+    def snapshot_state(self) -> Dict:  # guarded-by: caller(state_lock)
+        """The object-graph half of the WarmRestart snapshot: the four
+        state dicts plus the epoch.  NOT copied — the whole snapshot
+        payload pickles in one pass under the state lock, and sharing the
+        live dicts keeps node.pods entries identical to pods.values()
+        entries in the pickled graph (identity the arena's `_node_at`
+        rewiring and `gather()`'s `is` check depend on after restore)."""
+        return {
+            "nodes": self.nodes,
+            "nodeclaims": self.nodeclaims,
+            "pods": self.pods,
+            "pdbs": self.pdbs,
+            "mutation_epoch": self.mutation_epoch,
+        }
+
+    def restore_state(self, data: Dict) -> None:  # guarded-by: caller(state_lock)
+        """Adopt unpickled state dicts wholesale.  The caller re-attaches
+        (or restores) the arena and observer afterwards — this method
+        leaves both wiring hooks untouched."""
+        self.nodes = data["nodes"]
+        self.nodeclaims = data["nodeclaims"]
+        self.pods = data["pods"]
+        self.pdbs = data["pdbs"]
+        self.mutation_epoch = int(data["mutation_epoch"])
+
     # ---- pods ----
     def add_pod(self, pod: Pod) -> Pod:
         pod.created_at = self.clock()   # informer-arrival stamp (bind latency)

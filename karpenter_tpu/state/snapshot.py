@@ -1,0 +1,311 @@
+"""Operator state snapshots + warm restart (the WarmRestart gate).
+
+A plain process death used to cost a full world re-tensorization: restart
+recovery (`Operator.hydrate_cluster`) rebuilds NodeClaims from cloud tags,
+loses every pod binding, and the arena/guide/forecast caches start cold.
+This module serializes the whole control-plane working set —
+
+* `Cluster` dicts (nodes, claims, pods, PDBs) + mutation epoch,
+* the `ClusterArena` slab and registries (`ops/arena.py snapshot_state`),
+* solver-adjacent caches: LP mix/stale/support caches (`ops/lpguide.py`),
+  the unavailable-offerings ICE cache, the forecast demand series, the
+  solver-health ladder, and every controller supervisor's circuit state,
+* the fake-cloud substrate and interruption queue (so a resumed sim run
+  replays the exact launch/reclaim stream), and
+* the module-level name/id counters (probe-and-reset, net-zero draws) so
+  post-restore node names continue the uninterrupted sequence —
+
+into one versioned, checksummed file, written atomically (tmp +
+``os.replace``, the LeaderElector idiom) on a cadence and on SIGTERM.
+
+The payload is ONE ``pickle.dumps`` over a sections dict: shared
+references (a node's ``pods`` entries are the same objects as
+``cluster.pods`` values) survive as shared references, which the arena's
+identity-checked ``gather()`` depends on after restore.  Restore
+validates magic, version, checksum, and meta↔section epoch consistency;
+ANY mismatch is a counted, logged cold fallback — the operator simply
+hydrates from cloud state as before, so a corrupt snapshot can never be
+worse than no snapshot.  On the happy path the restored arena serves its
+first `gather()` warm: no `tensorize_nodes`, reconcile resumes in
+milliseconds.
+
+Cross-process cache hygiene: `_class_key` caches (plain content tuples
+stored on pods) pickle and stay valid; the *interned* `_cid` tokens from
+`ops/tensorize.py` are process-local, so restore bumps the class-id
+generation — every restored pod re-interns lazily instead of colliding
+with ids minted by the new process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import logging
+import os
+import pickle
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..utils import metrics
+
+log = logging.getLogger("karpenter_tpu.snapshot")
+
+MAGIC = b"KTSNAP01"
+VERSION = 1
+_HEADER_LEN = len(MAGIC) + 32  # magic + sha256(payload)
+
+
+# ---------------------------------------------------------------------------
+# module-level counters: probe-and-reset (read the next value, recreate the
+# counter at it — net zero draws, so snapshotting never perturbs the run)
+# ---------------------------------------------------------------------------
+
+def _counter_sites():
+    from ..api import objects as objects_mod
+    from ..cloud import fake as fake_mod
+    from ..cloud import queue as queue_mod
+    from . import cluster as cluster_mod
+    return (("node_names", cluster_mod, "_names"),
+            ("object_ids", objects_mod, "_ids"),
+            ("msg_ids", queue_mod, "_msg_ids"),
+            ("fleet_ids", fake_mod, "_fleet_ids"))
+
+
+def _snapshot_counters() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for key, mod, attr in _counter_sites():
+        v = next(getattr(mod, attr))
+        setattr(mod, attr, itertools.count(v))
+        out[key] = v
+    return out
+
+
+def _restore_counters(data: Dict[str, int]) -> None:
+    for key, mod, attr in _counter_sites():
+        if key in data:
+            setattr(mod, attr, itertools.count(int(data[key])))
+
+
+# ---------------------------------------------------------------------------
+# collection
+# ---------------------------------------------------------------------------
+
+def _health_of(manager) -> Optional[object]:
+    prov = manager.controllers.get("provisioning") \
+        if manager is not None else None
+    return getattr(prov, "health", None) if prov is not None else None
+
+
+def collect_sections(op, manager=None) -> Dict:
+    """Assemble the sections dict from a live operator (+ optional
+    manager).  Caller holds the state lock; nothing here blocks."""
+    from ..ops import lpguide
+    cluster = op.cluster
+    arena = cluster.arena
+    sections: Dict[str, object] = {
+        "counters": _snapshot_counters(),
+        "cluster": cluster.snapshot_state(),
+        "arena": arena.snapshot_state() if arena is not None else None,
+        "unavailable": op.unavailable.snapshot_state(),
+        "lpguide": lpguide.snapshot_caches(),
+        "cloud": op.raw_cloud.snapshot_state(),
+        "queue": op.queue.snapshot_state() if op.queue is not None else None,
+    }
+    observer = cluster.observer
+    if observer is not None and hasattr(observer, "snapshot_state"):
+        sections["series"] = observer.snapshot_state()
+    if manager is not None:
+        sections["supervisors"] = {
+            name: sup.snapshot_state()
+            for name, sup in manager.supervisors.items()}
+        bw = manager.batch_window
+        sections["manager"] = {
+            "entries": {e.name: e.last_run for e in manager._entries},
+            "batch_window": {"opened": bw._opened, "last_add": bw._last_add,
+                             "last_count": bw._last_count},
+        }
+        health = _health_of(manager)
+        if health is not None:
+            sections["health"] = health.snapshot_state()
+    sections["meta"] = {
+        "version": VERSION,
+        "written_at": op.clock(),
+        "cluster_epoch": cluster.mutation_epoch,
+        "arena_epoch": arena.epoch if arena is not None else None,
+    }
+    return sections
+
+
+# ---------------------------------------------------------------------------
+# file format: MAGIC ⊕ sha256(payload) ⊕ payload (one pickle)
+# ---------------------------------------------------------------------------
+
+def write_snapshot(path: str, op, manager=None) -> bool:
+    """Serialize + atomically replace `path`.  Returns success; a failed
+    write leaves the previous snapshot intact (tmp + rename)."""
+    t0 = time.perf_counter()
+    try:
+        payload = pickle.dumps(collect_sections(op, manager),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        blob = MAGIC + hashlib.sha256(payload).digest() + payload
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except Exception:
+        log.exception("snapshot write to %s failed", path)
+        metrics.snapshot_writes().inc({"outcome": "error"})
+        return False
+    metrics.snapshot_writes().inc({"outcome": "ok"})
+    metrics.snapshot_write_duration().observe(time.perf_counter() - t0)
+    metrics.snapshot_size().set(len(blob))
+    return True
+
+
+def load_sections(path: str) -> Tuple[Optional[Dict], str]:
+    """Read + validate a snapshot file.  Returns (sections, "ok") or
+    (None, reason) — reasons are the counted restore outcomes."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None, "missing"
+    if len(blob) < _HEADER_LEN or not blob.startswith(MAGIC):
+        return None, "bad_magic"
+    digest = blob[len(MAGIC):_HEADER_LEN]
+    payload = blob[_HEADER_LEN:]
+    if hashlib.sha256(payload).digest() != digest:
+        return None, "bad_checksum"
+    try:
+        sections = pickle.loads(payload)
+        if int(sections["meta"]["version"]) != VERSION:
+            return None, "bad_version"
+    except Exception:
+        return None, "bad_checksum"
+    meta = sections["meta"]
+    cluster_sec = sections.get("cluster") or {}
+    if meta.get("cluster_epoch") != cluster_sec.get("mutation_epoch"):
+        return None, "epoch_mismatch"
+    arena_sec = sections.get("arena")
+    arena_epoch = arena_sec["epoch"] if arena_sec is not None else None
+    if meta.get("arena_epoch") != arena_epoch:
+        return None, "epoch_mismatch"
+    return sections, "ok"
+
+
+# ---------------------------------------------------------------------------
+# restore
+# ---------------------------------------------------------------------------
+
+def restore_snapshot(path: str, op, manager=None) -> str:
+    """Warm-restore the operator from `path`.  Returns the counted outcome
+    ("restored", or the cold-fallback reason).  Caller holds the state
+    lock.  On ANY failure the operator is left on the cold path — arena
+    flagged for rebuild, cluster state whatever hydration built — which
+    is always correct, just slower."""
+    sections, reason = load_sections(path)
+    if sections is None:
+        log.warning("snapshot restore from %s: cold fallback (%s)",
+                    path, reason)
+        metrics.snapshot_restores().inc({"outcome": reason})
+        return reason
+    # pre-state for rollback: a half-applied restore must never leave a
+    # structurally invalid cluster, so on ANY apply exception we put the
+    # hydrated cold state (live dict refs, untouched by the failed apply)
+    # back before degrading
+    pre_cluster = op.cluster.snapshot_state()
+    pre_counters = _snapshot_counters()
+    try:
+        _apply_sections(sections, op, manager)
+    except Exception:
+        log.exception("snapshot restore from %s failed mid-apply; "
+                      "rolling back to cold state", path)
+        try:
+            _restore_counters(pre_counters)
+            op.cluster.restore_state(pre_cluster)
+        except Exception:
+            log.exception("rollback after failed restore also failed")
+        if op.cluster.arena is not None:
+            op.cluster.arena.invalidate("restore_failed")
+        metrics.snapshot_restores().inc({"outcome": "apply_error"})
+        return "apply_error"
+    age = max(0.0, op.clock() - float(sections["meta"]["written_at"]))
+    metrics.snapshot_restores().inc({"outcome": "restored"})
+    metrics.snapshot_age().set(age)
+    log.info("warm restore from %s: %d nodes, %d pods, snapshot age %.3fs",
+             path, len(op.cluster.nodes), len(op.cluster.pods), age)
+    return "restored"
+
+
+def _apply_sections(sections: Dict, op, manager=None) -> None:
+    from ..ops import lpguide
+    from ..ops.tensorize import _CLASS_GEN
+    _restore_counters(sections.get("counters", {}))
+    op.cluster.restore_state(sections["cluster"])
+    # restored pods carry _cid intern tokens from the dead process; bump
+    # the generation so they re-intern instead of colliding with ids the
+    # new process mints (their _ckey content tuples stay valid)
+    _CLASS_GEN[0] += 1
+    arena = op.cluster.arena
+    arena_sec = sections.get("arena")
+    if arena is not None:
+        if arena_sec is None or not arena.restore_state(arena_sec):
+            arena.invalidate("restore_mismatch")
+    op.unavailable.restore_state(sections["unavailable"])
+    lpguide.restore_caches(sections.get("lpguide", {}))
+    op.raw_cloud.restore_state(sections["cloud"])
+    if op.queue is not None and sections.get("queue") is not None:
+        op.queue.restore_state(sections["queue"])
+    observer = op.cluster.observer
+    if observer is not None and hasattr(observer, "restore_state") \
+            and "series" in sections:
+        observer.restore_state(sections["series"])
+    if manager is not None:
+        for name, data in sections.get("supervisors", {}).items():
+            sup = manager.supervisors.get(name)
+            if sup is not None:
+                sup.restore_state(data)
+        mgr_sec = sections.get("manager")
+        if mgr_sec is not None:
+            last_runs = mgr_sec.get("entries", {})
+            for e in manager._entries:
+                if e.name in last_runs:
+                    e.last_run = float(last_runs[e.name])
+            bw = mgr_sec.get("batch_window")
+            if bw is not None:
+                manager.batch_window._opened = bw["opened"]
+                manager.batch_window._last_add = bw["last_add"]
+                manager.batch_window._last_count = int(bw["last_count"])
+        health = _health_of(manager)
+        if health is not None and "health" in sections:
+            health.restore_state(sections["health"])
+
+
+# ---------------------------------------------------------------------------
+# cadence driver (held by the ControllerManager under the WarmRestart gate)
+# ---------------------------------------------------------------------------
+
+class SnapshotWriter:
+    """Periodic snapshot driver: `maybe_write(now)` from the tick loop,
+    `write_final()` from `stop()` (the SIGTERM hook)."""
+
+    def __init__(self, path: str, op, manager=None,
+                 interval_s: float = 30.0):
+        self.path = path
+        self.op = op
+        self.manager = manager
+        self.interval_s = float(interval_s)
+        self._last_written = float("-inf")
+
+    def maybe_write(self, now: float) -> bool:
+        if not self.path or now - self._last_written < self.interval_s:
+            return False
+        ok = write_snapshot(self.path, self.op, self.manager)
+        if ok:
+            self._last_written = now
+        return ok
+
+    def write_final(self) -> bool:
+        if not self.path:
+            return False
+        return write_snapshot(self.path, self.op, self.manager)

@@ -1102,3 +1102,83 @@ def chaos_injections() -> Counter:
         "karpenter_chaos_injections_total",
         "Faults injected by the chaos harness, by point and action.",
         labels=("point", "action"))
+
+
+# --- durability: state snapshots + ingestion batching ----------------------
+
+def snapshot_writes() -> Counter:
+    """State-snapshot write attempts (state/snapshot.py), by outcome:
+    `ok` or `error` (serialization/IO failure — the previous snapshot
+    file survives untouched because writes are tmp+rename atomic)."""
+    return REGISTRY.counter(
+        "karpenter_snapshot_writes_total",
+        "Operator state-snapshot writes by outcome.",
+        labels=("outcome",))
+
+
+def snapshot_write_duration() -> Histogram:
+    """Wall time of one snapshot write (serialize under the state lock +
+    atomic file replace)."""
+    return REGISTRY.histogram(
+        "karpenter_snapshot_write_duration_seconds",
+        "Duration of operator state-snapshot writes.")
+
+
+def snapshot_size() -> Gauge:
+    """Size of the last written snapshot file in bytes."""
+    return REGISTRY.gauge(
+        "karpenter_snapshot_size_bytes",
+        "Bytes in the most recent operator state snapshot.")
+
+
+def snapshot_restores() -> Counter:
+    """Warm-restore attempts, by outcome: `restored` (warm resume), or a
+    counted cold-fallback reason — `missing`, `bad_magic`, `bad_version`,
+    `bad_checksum`, `epoch_mismatch`, `apply_error`."""
+    return REGISTRY.counter(
+        "karpenter_snapshot_restores_total",
+        "Operator state-snapshot restore attempts by outcome.",
+        labels=("outcome",))
+
+
+def snapshot_age() -> Gauge:
+    """Clock age of the restored snapshot at restore time (how much
+    event history the warm resume had to catch up on)."""
+    return REGISTRY.gauge(
+        "karpenter_snapshot_age_seconds",
+        "Age of the snapshot consumed by the last warm restore.")
+
+
+def ingest_events() -> Counter:
+    """Cluster events absorbed by the ingestion batcher (state/ingest.py)
+    between ticks, by kind (node_add, node_remove, touch, pod_bind,
+    pod_unbind, pod_add, pod_remove, offering)."""
+    return REGISTRY.counter(
+        "karpenter_ingest_events_total",
+        "Events coalesced by the ingestion batcher, by kind.",
+        labels=("kind",))
+
+
+def ingest_flushes() -> Counter:
+    """Batched flushes applied to the arena — the coalescing ratio is
+    karpenter_ingest_events_total / karpenter_ingest_flushes_total."""
+    return REGISTRY.counter(
+        "karpenter_ingest_flushes_total",
+        "Ingestion-batcher flushes applied to the cluster arena.")
+
+
+def ingest_pending() -> Gauge:
+    """Coalesced events pending in the batcher right now (drops to 0 at
+    every flush)."""
+    return REGISTRY.gauge(
+        "karpenter_ingest_pending_events",
+        "Events currently pending in the ingestion batcher.")
+
+
+def ingest_overflows() -> Counter:
+    """Backpressure degradations: pending events crossed the overflow cap
+    and the batcher fell back to a full arena rebuild (events are folded
+    into the rebuild, never dropped)."""
+    return REGISTRY.counter(
+        "karpenter_ingest_overflows_total",
+        "Ingestion-batcher overflow degradations to full rebuild.")

@@ -52,9 +52,10 @@ SPAN_NAMES = frozenset({
     "disruption.expiration", "disruption.drift", "disruption.consolidation",
     "sweep.arena", "sweep.prefix", "sweep.decode", "sweep.single",
     # persistent cluster arena (ops/arena.py)
-    "arena.rebuild", "arena.compact",
+    "arena.rebuild", "arena.compact", "arena.ingest_flush",
     # fleet-scale partitioned solve (parallel/partition.py + driver.py)
     "shard.partition", "shard.solve", "shard.reconcile",
+    "shard.tensorize", "shard.kernel", "shard.assemble",
     # refinery + LP guide
     "refinery.refine", "refinery.lp", "refinery.price",
     # forecast/headroom reconcile

@@ -1,0 +1,263 @@
+"""IngestBatcher suite: a batched event window flushed as one arena delta
+must stay BIT-IDENTICAL in gather output to the eager per-event stream —
+through bind churn, node add/remove interleavings, removal-cancels-add,
+add-after-remove revival — plus the coalescing economics (N events → 1
+delta), the overflow degrade-to-rebuild contract (never drops), and the
+gate plumbing through Options and the Operator (ISSUE 11 tentpole b)."""
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import Disruption, Node, NodePool
+from karpenter_tpu.api.resources import CPU, MEMORY, PODS, ResourceList
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.ingest import IngestBatcher
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def env(batched=True, max_events=100_000):
+    clock = FakeClock()
+    cloud = FakeCloud(clock)
+    provider = CloudProvider(cloud, small_catalog(), clock=clock)
+    cluster = Cluster(clock)
+    cluster.attach_arena()
+    if batched:
+        cluster.arena = IngestBatcher(cluster.arena, max_events=max_events)
+    pools = [NodePool(disruption=Disruption(
+        consolidation_policy="WhenUnderutilized"))]
+    prov = Provisioner(provider, cluster, pools, clock=clock)
+    return cluster, prov
+
+
+def plain_node(i):
+    return Node(name=f"ing-{i:03d}",
+                allocatable=ResourceList({CPU: 4000, MEMORY: 8 * 2 ** 30,
+                                          PODS: 110}),
+                labels={wk.INSTANCE_TYPE: "a.medium", wk.ZONE: "zone-a"})
+
+
+def reps():
+    return [cpu_pod(cpu_m=500, mem_mib=512),
+            cpu_pod(cpu_m=1500, mem_mib=2048)]
+
+
+def assert_batched_equals_eager(mutate):
+    """Run `mutate(cluster, prov)` against a batched and an eager cluster;
+    the final gather output (the only thing the solver reads) must match
+    value-for-value.  Slot layout may differ — gather orders by
+    cluster.nodes, so layout is invisible by design."""
+    from karpenter_tpu.sim.harness import _reset_global_counters
+    out = []
+    for batched in (True, False):
+        _reset_global_counters()   # node names restart per run, so the
+        cluster, prov = env(batched=batched)  # two streams name identically
+        mutate(cluster, prov)
+        g = cluster.arena.gather(reps())
+        assert g is not None, f"batched={batched} gather fell back"
+        nodes, alloc, used, compat = g
+        out.append(([n.name for n in nodes], alloc, used, compat))
+    (bn, ba, bu, bc), (en, ea, eu, ec) = out
+    assert bn == en
+    np.testing.assert_array_equal(ba, ea)
+    np.testing.assert_array_equal(bu, eu)
+    np.testing.assert_array_equal(bc, ec)
+
+
+# ---------------------------------------------------------------------------
+# batched ≡ eager bit-identity
+# ---------------------------------------------------------------------------
+
+def test_provision_churn_batched_equals_eager():
+    def mutate(cluster, prov):
+        cluster.add_pods([cpu_pod(cpu_m=700, mem_mib=900)
+                          for _ in range(6)])
+        prov.provision()
+        victims = sorted(cluster.pods.values(), key=lambda p: p.uid)
+        for p in victims[:2]:
+            cluster.delete_pod(p)
+    assert_batched_equals_eager(mutate)
+
+
+def test_node_add_remove_interleaving_batched_equals_eager():
+    def mutate(cluster, prov):
+        for i in range(6):
+            cluster.add_node(plain_node(i))
+        for name in sorted(cluster.nodes)[:3]:
+            cluster.remove_node(name)
+        for i in range(6, 9):
+            cluster.add_node(plain_node(i))
+    assert_batched_equals_eager(mutate)
+
+
+def test_taint_edit_then_remove_then_revive_batched_equals_eager():
+    def mutate(cluster, prov):
+        for i in range(3):
+            cluster.add_node(plain_node(i))
+        # flush so the nodes are tracked, then churn within one window
+        cluster.arena.gather(reps()) if hasattr(cluster.arena, "flush") \
+            else None
+        node = cluster.nodes[sorted(cluster.nodes)[0]]
+        node.taints = [Taint(key="edited")]
+        cluster.touch_node(node)
+        cluster.remove_node(node.name)
+        revived = plain_node(99)
+        revived.name = node.name  # add-after-remove within the window
+        cluster.add_node(revived)
+    assert_batched_equals_eager(mutate)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_stream_batched_equals_eager(seed):
+    def mutate(cluster, prov):
+        rng = np.random.default_rng(seed)
+        for step in range(25):
+            op = rng.integers(0, 5)
+            if op == 0:
+                cluster.add_pods([cpu_pod(
+                    cpu_m=int(rng.integers(200, 1800)),
+                    mem_mib=int(rng.integers(256, 3000)))])
+                prov.provision()
+            elif op == 1 and cluster.pods:
+                victims = sorted(cluster.pods.values(), key=lambda p: p.uid)
+                cluster.delete_pod(victims[int(rng.integers(len(victims)))])
+            elif op == 2 and cluster.pods:
+                bound = [p for p in cluster.pods.values() if p.node_name]
+                if bound:
+                    cluster.unbind_pod(bound[int(rng.integers(len(bound)))])
+            elif op == 3 and len(cluster.nodes) > 1:
+                names = sorted(cluster.nodes)
+                cluster.remove_node(names[int(rng.integers(len(names)))])
+            elif op == 4 and cluster.nodes:
+                names = sorted(cluster.nodes)
+                node = cluster.nodes[names[int(rng.integers(len(names)))]]
+                node.taints = [] if node.taints else [Taint(key="edited")]
+                cluster.touch_node(node)
+    assert_batched_equals_eager(mutate)
+
+
+# ---------------------------------------------------------------------------
+# coalescing economics: the window is one delta, not N
+# ---------------------------------------------------------------------------
+
+def test_event_firehose_coalesces_to_one_delta():
+    cluster, prov = env()
+    batcher = cluster.arena
+    cluster.add_pods([cpu_pod() for _ in range(4)])
+    prov.provision()
+    batcher.flush()
+    inner = batcher._arena
+    epoch0 = inner.epoch
+    # a firehose window: hundreds of binds/unbinds against a fixed fleet
+    bound = sorted((p for p in cluster.pods.values() if p.node_name),
+                   key=lambda p: p.uid)
+    for _ in range(100):
+        for p in bound:
+            cluster.unbind_pod(p)
+            cluster.bind_pod(p, sorted(cluster.nodes)[0])
+    events_in_window = batcher.events_total
+    assert events_in_window >= 200
+    assert inner.epoch == epoch0          # nothing applied yet
+    assert batcher.flush()
+    assert inner.epoch == epoch0 + 1      # the whole window was ONE delta
+    # coalesce ratio is the soak gate's ≥100x claim in miniature
+    assert events_in_window / 1 >= 100
+
+
+def test_empty_window_flush_is_free():
+    cluster, prov = env()
+    inner = cluster.arena._arena
+    epoch0 = inner.epoch
+    assert cluster.arena.flush() is False
+    assert inner.epoch == epoch0
+
+
+def test_gather_flushes_as_safety_net():
+    cluster, prov = env()
+    for i in range(3):
+        cluster.add_node(plain_node(i))
+    assert cluster.arena.pending > 0
+    g = cluster.arena.gather(reps())
+    assert g is not None
+    assert cluster.arena.pending == 0
+    assert len(g[0]) == 3                 # absorbed adds all visible
+
+
+def test_removal_cancels_pending_add_entirely():
+    cluster, prov = env()
+    batcher = cluster.arena
+    node = plain_node(0)
+    cluster.add_node(node)
+    cluster.remove_node(node.name)        # add+remove inside one window
+    assert batcher.pending == 0           # cancels out: no arena work at all
+    batcher.flush()
+    assert node.name not in batcher._arena._slot_of
+
+
+# ---------------------------------------------------------------------------
+# overflow: degrade to rebuild, never drop
+# ---------------------------------------------------------------------------
+
+def test_overflow_degrades_to_rebuild_without_dropping():
+    cluster, prov = env(max_events=4)
+    batcher = cluster.arena
+    for i in range(8):                    # pending > max_events mid-stream
+        cluster.add_node(plain_node(i))
+    assert batcher.overflows_total >= 1
+    assert batcher._arena._needs_rebuild  # degraded to full rebuild
+    # NOTHING was dropped: the rebuild re-derives every node from cluster
+    # state, so gather sees all 8
+    g = cluster.arena.gather(reps())
+    assert g is not None and len(g[0]) == 8
+    s_nodes, s_alloc, s_used, s_compat = cluster.tensorize_nodes(reps())
+    np.testing.assert_array_equal(g[1], s_alloc)
+    np.testing.assert_array_equal(g[2], s_used)
+
+
+def test_invalidate_clears_pending_window():
+    cluster, prov = env()
+    cluster.add_node(plain_node(0))
+    assert cluster.arena.pending == 1
+    cluster.arena.invalidate("test")
+    assert cluster.arena.pending == 0
+    assert cluster.arena._arena._needs_rebuild
+
+
+# ---------------------------------------------------------------------------
+# gate plumbing
+# ---------------------------------------------------------------------------
+
+def test_gate_defaults_off_and_flags():
+    from karpenter_tpu.operator.options import Options
+    assert not Options().gate("IngestBatch")
+    assert not Options().gate("WarmRestart")
+    opts = Options.from_args(["--ingest-batch", "--warm-restart",
+                              "--snapshot-path", "/tmp/s.bin",
+                              "--snapshot-interval", "7.5",
+                              "--ingest-max-events", "1234"])
+    assert opts.gate("IngestBatch") and opts.gate("WarmRestart")
+    assert opts.snapshot_path == "/tmp/s.bin"
+    assert opts.snapshot_interval_s == 7.5
+    assert opts.ingest_max_events == 1234
+
+
+def test_operator_wraps_arena_under_gate():
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.operator import Operator, Options
+    opts = Options()
+    opts.feature_gates["IngestBatch"] = True
+    op = Operator(opts, catalog=generate_catalog(5))
+    assert isinstance(op.cluster.arena, IngestBatcher)
+    op2 = Operator(Options(), catalog=generate_catalog(5))
+    assert not isinstance(op2.cluster.arena, IngestBatcher)

@@ -246,6 +246,10 @@ GOLDEN_CASES = [
     # launch retries, and a ladder demote/recover — the chaos report
     # section is part of the golden
     ("chaos-storm", "chaos-storm.yaml", 5400.0),
+    # the 24h endurance firehose (8 pods/s, 100ms cadence), pinned at a
+    # short prefix — the full horizon runs gated (`make soak-smoke`,
+    # `bench.py --soak`)
+    ("long-soak", "long-soak.yaml", 120.0),
 ]
 
 
@@ -302,6 +306,40 @@ def test_golden_report_sharded_solve_gate(gate):
         assert got == fh.read(), (
             f"sharded_solve={gate} report for {fname} diverged from "
             f"{path}: the gate changed behavior, not just placement")
+
+
+@pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
+                         ids=[c[0] for c in GOLDEN_CASES])
+def test_golden_report_durability_gates_off(name, fname, duration):
+    """WarmRestart and IngestBatch default OFF and, explicitly off, must
+    leave every canned scenario's report byte-identical — the durability
+    layer cannot perturb a run that never snapshots or batches."""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     warm_restart=False, ingest_batch=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"durability-gates-off report for {fname} diverged from {path}")
+
+
+def test_golden_report_ingest_batch_gate_on():
+    """IngestBatch coalesces events between ticks but every flushed row
+    re-derives from current cluster state through the same math as the
+    eager path — so even the arena-heavy 100ms-cadence drip scenario must
+    reproduce its golden byte-for-byte with the gate ON."""
+    name, fname, duration = next(c for c in GOLDEN_CASES
+                                 if c[0] == "steady-state-drip")
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     ingest_batch=True).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"ingest_batch=on report for {fname} diverged from {path}: "
+            f"coalescing changed behavior, not just cost")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,581 @@
+"""Warm-restart suite (ISSUE 11 tentpole a): the snapshot/restore layer
+must make process death invisible — a kill -9'd operator resumed from its
+last snapshot produces the byte-identical plan stream an uninterrupted run
+would, serves its first gather WARM (no tensorize_nodes), and continues
+module-level name counters without collision.  Corruption of any kind
+(truncated file, flipped bytes, stale epochs, wrong version) is a counted
+cold fallback, never a crash, never silently-wrong state.  Includes the
+mid-lifecycle taint interleaving regression (satellite 3) and the chaos ×
+restart consistency check (satellite 4)."""
+
+import hashlib
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import (ImageInfo, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.operator import (ControllerManager, Operator, Options,
+                                    build_controllers)
+from karpenter_tpu.state import snapshot as snap_mod
+from karpenter_tpu.state.snapshot import (MAGIC, load_sections,
+                                          restore_snapshot, write_snapshot)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def seed_cloud(op):
+    op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {}),
+                        SubnetInfo("s-b", "zone-b", 10_000, {})]
+    op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+    op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+    op.params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    return op
+
+
+def pod(name=None, cpu=500):
+    return Pod(name=name,
+               requests=ResourceList({CPU: cpu, MEMORY: 512 * 2**20}))
+
+
+def stack(clock, snap_path="", gates=(), cloud=None):
+    opts = Options(snapshot_path=snap_path, interruption_queue="q")
+    for g in gates:
+        opts.feature_gates[g] = True
+    op = seed_cloud(Operator(opts, cloud=cloud, catalog=generate_catalog(10),
+                             clock=clock))
+    mgr = ControllerManager(op, build_controllers(op), clock=clock)
+    return op, mgr
+
+
+def provisioned_stack(clk, snap_path="", gates=("WarmRestart",)):
+    clock = lambda: clk[0]
+    op, mgr = stack(clock, snap_path, gates)
+    op.cluster.add_pods([pod() for _ in range(6)])
+    mgr.tick()
+    clk[0] += 1.1
+    mgr.tick()
+    assert op.cluster.nodes and not op.cluster.pending_pods()
+    return op, mgr
+
+
+def gather_of(op):
+    g = op.cluster.arena.gather(list(op.cluster.pods.values()))
+    assert g is not None, "gather unexpectedly fell back"
+    return g
+
+
+# ---------------------------------------------------------------------------
+# happy path: restore is warm, exact, and counter-safe
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    def test_restore_is_exact_and_warm(self, tmp_path):
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path)
+        assert write_snapshot(path, op, mgr)
+
+        op2, mgr2 = stack(lambda: clk[0], path, ("WarmRestart",))
+        assert restore_snapshot(path, op2, mgr2) == "restored"
+        assert set(op2.cluster.nodes) == set(op.cluster.nodes)
+        assert set(op2.cluster.pods) == set(op.cluster.pods)
+        assert op2.cluster.mutation_epoch == op.cluster.mutation_epoch
+
+        # the happy-path contract: the first gather never re-tensorizes
+        import karpenter_tpu.state.cluster as cmod
+        calls = [0]
+        orig = cmod.Cluster.tensorize_nodes
+
+        def counting(self, *a, **k):
+            calls[0] += 1
+            return orig(self, *a, **k)
+
+        cmod.Cluster.tensorize_nodes = counting
+        try:
+            n2, a2, u2, c2 = gather_of(op2)
+            n1, a1, u1, c1 = gather_of(op)
+        finally:
+            cmod.Cluster.tensorize_nodes = orig
+        assert calls[0] == 0
+        assert [n.name for n in n1] == [n.name for n in n2]
+        np.testing.assert_array_equal(a1, a2)
+        np.testing.assert_array_equal(u1, u2)
+        np.testing.assert_array_equal(c1, c2)
+
+    def test_restored_object_identity_pods_shared(self, tmp_path):
+        """Single-pickle identity: a node's pods list entries must BE the
+        cluster.pods values, or the arena's identity-checked refresh path
+        and every mutator walking node.pods silently diverge."""
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path)
+        assert write_snapshot(path, op, mgr)
+        op2, mgr2 = stack(lambda: clk[0], path, ("WarmRestart",))
+        assert restore_snapshot(path, op2, mgr2) == "restored"
+        for node in op2.cluster.nodes.values():
+            for p in node.pods:
+                assert op2.cluster.pods.get(p.uid) is p
+
+    def test_counters_continue_without_collision(self, tmp_path):
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path)
+        before = set(op.cluster.nodes)
+        assert write_snapshot(path, op, mgr)
+
+        op2, mgr2 = stack(lambda: clk[0], path, ("WarmRestart",))
+        assert restore_snapshot(path, op2, mgr2) == "restored"
+        # force more capacity: new node names must extend, not collide
+        op2.cluster.add_pods([pod(cpu=3900) for _ in range(4)])
+        clk[0] += 15.0
+        mgr2.tick()
+        clk[0] += 1.1
+        mgr2.tick()
+        grown = set(op2.cluster.nodes)
+        assert grown > before
+        new = grown - before
+        assert new and all(n not in before for n in new)
+        old_max = max(int(n.rsplit("-", 1)[1]) for n in before)
+        assert all(int(n.rsplit("-", 1)[1]) > old_max for n in new)
+
+    def test_snapshot_write_is_nonperturbing(self, tmp_path):
+        """Probe-and-reset counter capture and live-dict export: writing a
+        snapshot must not change what the run does next."""
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path)
+        epoch = op.cluster.mutation_epoch
+        names_before = set(op.cluster.nodes)
+        assert write_snapshot(path, op, mgr)
+        assert op.cluster.mutation_epoch == epoch
+        # the next provisioned node is named exactly as if no snapshot ran
+        op.cluster.add_pods([pod(cpu=3900)])
+        clk[0] += 15.0
+        mgr.tick()
+        clk[0] += 1.1
+        mgr.tick()
+        new = set(op.cluster.nodes) - names_before
+        old_max = max(int(n.rsplit("-", 1)[1]) for n in names_before)
+        assert {int(n.rsplit("-", 1)[1]) for n in new} == \
+            {old_max + 1 + i for i in range(len(new))}
+
+
+# ---------------------------------------------------------------------------
+# corruption taxonomy: every bad snapshot is a counted cold fallback
+# ---------------------------------------------------------------------------
+
+def _rewrite(path, mutate_sections):
+    """Load, mutate the pickled sections, re-checksum, write back — forging
+    a snapshot that passes integrity checks but fails semantic ones."""
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    sections = pickle.loads(blob[len(MAGIC) + 32:])
+    mutate_sections(sections)
+    payload = pickle.dumps(sections, protocol=pickle.HIGHEST_PROTOCOL)
+    with open(path, "wb") as fh:
+        fh.write(MAGIC + hashlib.sha256(payload).digest() + payload)
+
+
+class TestCorruption:
+    @pytest.fixture
+    def snap(self, tmp_path):
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path)
+        assert write_snapshot(path, op, mgr)
+        return clk, path
+
+    def _restore_cold(self, clk, path, expected):
+        op2, mgr2 = stack(lambda: clk[0], path, ("WarmRestart",))
+        assert restore_snapshot(path, op2, mgr2) == expected
+        # cold fallback still leaves a WORKING operator: hydration already
+        # rebuilt the fleet from cloud tags in this shared-substrate-free
+        # test, so just prove the loop still ticks and gathers
+        clk[0] += 15.0
+        mgr2.tick()
+        return op2
+
+    def test_missing_file(self, snap):
+        clk, path = snap
+        self._restore_cold(clk, path + ".nope", "missing")
+
+    def test_bad_magic(self, snap):
+        clk, path = snap
+        with open(path, "r+b") as fh:
+            fh.write(b"NOTASNAP")
+        self._restore_cold(clk, path, "bad_magic")
+
+    def test_truncated_header(self, snap):
+        clk, path = snap
+        with open(path, "wb") as fh:
+            fh.write(MAGIC[:4])
+        self._restore_cold(clk, path, "bad_magic")
+
+    def test_flipped_payload_byte(self, snap):
+        clk, path = snap
+        with open(path, "rb") as fh:
+            blob = bytearray(fh.read())
+        blob[-10] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(bytes(blob))
+        self._restore_cold(clk, path, "bad_checksum")
+
+    def test_version_skew(self, snap):
+        clk, path = snap
+
+        def bump(sections):
+            sections["meta"]["version"] = 99
+
+        _rewrite(path, bump)
+        self._restore_cold(clk, path, "bad_version")
+
+    def test_epoch_mismatch(self, snap):
+        clk, path = snap
+
+        def skew(sections):
+            sections["meta"]["cluster_epoch"] += 1
+
+        _rewrite(path, skew)
+        self._restore_cold(clk, path, "epoch_mismatch")
+
+    def test_apply_error_falls_back_cold(self, snap):
+        clk, path = snap
+
+        def poison(sections):
+            # keep the epoch so validation passes and only the apply fails
+            sections["cluster"] = {
+                "mutation_epoch": sections["cluster"]["mutation_epoch"],
+                "nodes": "not a dict"}
+
+        _rewrite(path, poison)
+        op2 = self._restore_cold(clk, path, "apply_error")
+        # the arena was invalidated, and the rebuild path still serves
+        assert gather_of(op2) is not None
+
+    def test_outcomes_are_counted(self, snap):
+        from karpenter_tpu.utils import metrics
+        clk, path = snap
+        fam = metrics.snapshot_restores()
+        before = {o: fam.value({"outcome": o})
+                  for o in ("restored", "bad_magic")}
+        op2, mgr2 = stack(lambda: clk[0], path, ("WarmRestart",))
+        assert restore_snapshot(path, op2, mgr2) == "restored"
+        with open(path, "r+b") as fh:
+            fh.write(b"NOTASNAP")
+        op3, mgr3 = stack(lambda: clk[0], path, ("WarmRestart",))
+        assert restore_snapshot(path, op3, mgr3) == "bad_magic"
+        assert fam.value({"outcome": "restored"}) == before["restored"] + 1
+        assert fam.value({"outcome": "bad_magic"}) == before["bad_magic"] + 1
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: mid-lifecycle snapshot (tainted, not yet terminated)
+# ---------------------------------------------------------------------------
+
+def test_midlifecycle_taint_interleaved_snapshot_restore(tmp_path):
+    """Snapshot a node mid-disruption — cordon taint applied, termination
+    not yet started — with touch_node deltas interleaved around the
+    snapshot; the restored gather must be bit-identical to the live one
+    AND keep tracking subsequent touches exactly."""
+    clk = [1000.0]
+    path = str(tmp_path / "snap.bin")
+    op, mgr = provisioned_stack(clk, path)
+    name = sorted(op.cluster.nodes)[0]
+    node = op.cluster.nodes[name]
+    node.taints = list(node.taints) + [Taint("karpenter.sh/disrupting",
+                                             "NoSchedule")]
+    op.cluster.touch_node(node)                   # pre-snapshot touch
+    assert write_snapshot(path, op, mgr)
+
+    node.taints = [t for t in node.taints
+                   if t.key != "karpenter.sh/disrupting"]
+    op.cluster.touch_node(node)                   # post-snapshot touch:
+    #                                               must NOT leak into it
+    op2, mgr2 = stack(lambda: clk[0], path, ("WarmRestart",))
+    assert restore_snapshot(path, op2, mgr2) == "restored"
+    node2 = op2.cluster.nodes[name]
+    assert any(t.key == "karpenter.sh/disrupting" for t in node2.taints)
+
+    # restored gather equals a from-scratch tensorize of restored state
+    reps = list(op2.cluster.pods.values())
+    g = op2.cluster.arena.gather(reps)
+    assert g is not None
+    s_nodes, s_alloc, s_used, s_compat = op2.cluster.tensorize_nodes(reps)
+    assert [n.name for n in g[0]] == [n.name for n in s_nodes]
+    np.testing.assert_array_equal(g[1], s_alloc)
+    np.testing.assert_array_equal(g[2], s_used)
+    np.testing.assert_array_equal(g[3], s_compat)
+
+    # interleaved touches AFTER restore keep the slab current
+    node2.taints = []
+    op2.cluster.touch_node(node2)
+    g2 = op2.cluster.arena.gather(reps)
+    s2 = op2.cluster.tensorize_nodes(reps)
+    np.testing.assert_array_equal(g2[3], s2[3])
+
+
+# ---------------------------------------------------------------------------
+# satellite 4: chaos × restart — circuits/ladder/ICE cache survive
+# ---------------------------------------------------------------------------
+
+def test_chaos_restart_restores_circuits_ladder_and_ice(tmp_path):
+    clk = [1000.0]
+    path = str(tmp_path / "snap.bin")
+    op, mgr = provisioned_stack(clk, path)
+
+    # wound the control plane: supervisor failures (one quarantined), a
+    # demoted solver rung, and ICE'd offerings
+    boom = RuntimeError("chaos")
+    for _ in range(3):
+        mgr.supervisors["disruption"].record_failure(clk[0], boom)
+    for _ in range(20):
+        mgr.supervisors["tagging"].record_failure(clk[0], boom)
+    health = mgr.controllers["provisioning"].health
+    for _ in range(3):
+        health.report_failure("jax", "timeout")
+    it = op.catalog[0]
+    o = it.offerings[0]
+    op.unavailable.mark_unavailable("chaos", it.name, o.zone,
+                                    o.capacity_type)
+    assert write_snapshot(path, op, mgr)
+
+    op2, mgr2 = stack(lambda: clk[0], path, ("WarmRestart",))
+    assert restore_snapshot(path, op2, mgr2) == "restored"
+    # supervisors: exact round trip, including the quarantine
+    for name in ("disruption", "tagging", "provisioning"):
+        assert mgr2.supervisors[name].snapshot_state() == \
+            mgr.supervisors[name].snapshot_state(), name
+    tagging = mgr2.supervisors["tagging"].snapshot_state()
+    assert tagging["state"] == "open"          # circuit open = quarantined
+    assert tagging["total_quarantines"] >= 1
+    # solver ladder: the demotion carries over (same injected clock domain)
+    health2 = mgr2.controllers["provisioning"].health
+    assert health2.snapshot_state() == health.snapshot_state()
+    assert health2.active_rung("jax") != "jax"
+    # ICE cache: the blacklisted offering is still unavailable
+    assert op2.unavailable.is_unavailable(o.capacity_type, it.name, o.zone)
+    assert op2.unavailable.seq_num == op.unavailable.seq_num
+
+    # and the resumed loop still converges under fresh load
+    op2.cluster.add_pods([pod() for _ in range(3)])
+    for _ in range(30):
+        clk[0] += 5.0
+        mgr2.tick()
+    assert not op2.cluster.pending_pods()
+
+
+def test_restart_mid_chaos_storm_converges(tmp_path):
+    """Integration cut of satellite 4: random interruptions/ICE for a
+    while, snapshot, 'kill' the operator (drop every object), restore a
+    successor over the SAME cloud, keep the storm going — the successor
+    must converge to all-bound with no leaked instances."""
+    clk = [10_000.0]
+    path = str(tmp_path / "snap.bin")
+    clock = lambda: clk[0]
+    op, mgr = stack(clock, path, ("WarmRestart",))
+    rng = np.random.default_rng(7)
+    op.cluster.add_pods([
+        Pod(requests=ResourceList({CPU: int(rng.integers(200, 3000)),
+                                   MEMORY: int(rng.integers(256, 4096))
+                                   * 2**20}))
+        for _ in range(20)])
+    for _ in range(40):
+        clk[0] += rng.uniform(2.0, 12.0)
+        running = op.cloud.running()
+        roll = rng.random()
+        if running and roll < 0.2:
+            op.cloud.interrupt(running[int(rng.integers(len(running)))].id)
+        elif roll < 0.35:
+            it = op.catalog[int(rng.integers(len(op.catalog)))]
+            o = it.offerings[int(rng.integers(len(it.offerings)))]
+            op.unavailable.mark_unavailable("chaos", it.name, o.zone,
+                                            o.capacity_type)
+        mgr.tick()
+    assert write_snapshot(path, op, mgr)
+
+    # successor over the same substrate (the cloud outlives the process)
+    op2, mgr2 = stack(clock, path, ("WarmRestart",), cloud=op.raw_cloud)
+    assert restore_snapshot(path, op2, mgr2) == "restored"
+    for _ in range(30):
+        clk[0] += rng.uniform(2.0, 12.0)
+        running = op2.cloud.running()
+        if running and rng.random() < 0.15:
+            op2.cloud.interrupt(running[int(rng.integers(len(running)))].id)
+        mgr2.tick()
+    for _ in range(40):
+        clk[0] += 5.0
+        mgr2.tick()
+    assert not op2.cluster.pending_pods()
+    known = {n.provider_id for n in op2.cluster.nodes.values()}
+    for inst in op2.cloud.running():
+        assert inst.id in known, f"leaked instance {inst.id}"
+
+
+# ---------------------------------------------------------------------------
+# manager wiring: cadence, SIGTERM hook, gate-off inertness
+# ---------------------------------------------------------------------------
+
+class TestManagerWiring:
+    def test_cadence_writes_and_stop_writes_final(self, tmp_path):
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path,
+                                    gates=("WarmRestart",))
+        mgr._snapshotter.interval_s = 5.0
+        assert os.path.exists(path)  # first tick past -inf wrote one
+        mtime = os.path.getmtime(path)
+        size = os.path.getsize(path)
+        clk[0] += 6.0
+        mgr.tick()
+        assert os.path.getsize(path) >= size  # cadence rewrote it
+        # stop() = the SIGTERM hook: mutate state, stop, the final file
+        # must contain the post-mutation world
+        op.cluster.add_pods([pod(name="final-proof")])
+        mgr.stop()
+        sections, reason = load_sections(path)
+        assert reason == "ok"
+        assert any(p.name == "final-proof"
+                   for p in sections["cluster"]["pods"].values())
+
+    def test_gate_off_never_writes(self, tmp_path):
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path, gates=())
+        assert mgr._snapshotter is None
+        clk[0] += 100.0
+        mgr.tick()
+        mgr.stop()
+        assert not os.path.exists(path)
+
+    def test_atomic_write_leaves_no_tmp(self, tmp_path):
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = provisioned_stack(clk, path)
+        assert write_snapshot(path, op, mgr)
+        assert not os.path.exists(path + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# the kill -9 acceptance test: plan-stream parity across a hard death
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+from karpenter_tpu.operator import (ControllerManager, Operator, Options,
+                                    build_controllers)
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import (ImageInfo, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.state.snapshot import restore_snapshot, write_snapshot
+
+snap, plan, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+kill_after = int(sys.argv[4]) if len(sys.argv) > 4 else -1
+resume = kill_after < 0 and os.path.exists(plan) and \
+    os.path.getsize(plan) > 0
+
+start_tick = 0
+if resume:
+    with open(plan) as fh:
+        start_tick = sum(1 for _ in fh)
+
+clk = [1000.0 + 1.1 * start_tick]
+opts = Options(snapshot_path=snap)
+opts.feature_gates.update({{"WarmRestart": True, "IngestBatch": True}})
+op = Operator(opts, catalog=generate_catalog(10), clock=lambda: clk[0])
+op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {{}}),
+                    SubnetInfo("s-b", "zone-b", 10_000, {{}})]
+op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {{}})]
+op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+op.params.parameters = {{
+    "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}}
+mgr = ControllerManager(op, build_controllers(op), clock=lambda: clk[0])
+
+cold = [0]
+if resume:
+    outcome = restore_snapshot(snap, op, mgr)
+    assert outcome == "restored", outcome
+    orig = type(op.cluster).tensorize_nodes
+    def counting(self, *a, **k):
+        cold[0] += 1
+        return orig(self, *a, **k)
+    type(op.cluster).tensorize_nodes = counting
+
+for k in range(start_tick, total):
+    clk[0] = 1000.0 + 1.1 * (k + 1)
+    if k % 3 == 0:
+        op.cluster.add_pods([
+            Pod(name=f"p-{{k}}-{{i}}",
+                requests=ResourceList({{CPU: 500, MEMORY: 512 * 2**20}}))
+            for i in range(2)])
+    mgr.tick()
+    if resume and k == start_tick:
+        type(op.cluster).tensorize_nodes = orig
+        print(f"COLD_TENSORIZE {{cold[0]}}", flush=True)
+    line = {{"k": k,
+             "nodes": sorted(op.cluster.nodes),
+             "bound": sorted(p.name for p in op.cluster.pods.values()
+                             if p.node_name),
+             "running": sorted(i.id for i in op.cloud.running())}}
+    with open(plan, "a") as fh:
+        fh.write(json.dumps(line, sort_keys=True) + "\n")
+    assert write_snapshot(snap, op, mgr)
+    if k == kill_after:
+        os.kill(os.getpid(), signal.SIGKILL)   # the real thing: no atexit,
+        #                                        no finally, no flushes
+print("DONE", flush=True)
+"""
+
+
+@pytest.mark.scale
+def test_kill_9_resume_plan_parity(tmp_path):
+    """Run the deterministic driver uninterrupted; run it again but
+    SIGKILL the process mid-run and resume a successor from the snapshot.
+    The concatenated plan stream must be byte-identical, and the resumed
+    first tick must not re-tensorize (COLD_TENSORIZE 0)."""
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD.format(repo=REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    total, kill_at = 12, 4
+
+    def run(snap, plan, kill=-1):
+        return subprocess.run(
+            [sys.executable, str(child), str(snap), str(plan),
+             str(total), str(kill)],
+            capture_output=True, text=True, env=env, timeout=300)
+
+    # A: uninterrupted
+    pa = tmp_path / "plan_a.jsonl"
+    proc = run(tmp_path / "snap_a.bin", pa)
+    assert proc.returncode == 0, proc.stderr
+    assert "DONE" in proc.stdout
+
+    # B: killed hard at tick 4, then resumed to completion
+    sb, pb = tmp_path / "snap_b.bin", tmp_path / "plan_b.jsonl"
+    proc = run(sb, pb, kill=kill_at)
+    assert proc.returncode == -signal.SIGKILL
+    assert len(pb.read_text().splitlines()) == kill_at + 1
+    proc = run(sb, pb)
+    assert proc.returncode == 0, proc.stderr
+    assert "COLD_TENSORIZE 0" in proc.stdout
+    assert "DONE" in proc.stdout
+
+    assert pa.read_text() == pb.read_text(), (
+        "plan stream diverged across kill -9 + warm restore")
+    # the parity is meaningful: the run actually planned capacity
+    last = json.loads(pa.read_text().splitlines()[-1])
+    assert last["nodes"] and last["bound"] and last["running"]

@@ -1,0 +1,90 @@
+"""Endurance-soak gate (ISSUE 11 tentpole c): `bench.py --soak` tracks
+p99 coalesced-delta-tick latency and RSS across 10⁶ ticks and fails on
+drift.  This suite runs a truncated soak end to end (all three gates must
+hold on a healthy build) and unit-tests the drift detector itself — a
+gate that can't fire is no gate."""
+
+import importlib
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    sys.path.insert(0, REPO)
+    try:
+        return importlib.import_module("bench")
+    finally:
+        sys.path.remove(REPO)
+
+
+# ---------------------------------------------------------------------------
+# the drift detector
+# ---------------------------------------------------------------------------
+
+def test_window_p99s_shapes(bench):
+    lat = list(np.linspace(1.0, 1.0, 2000))
+    p99s = bench._window_p99s(lat, n_windows=20)
+    assert len(p99s) == 20
+    assert all(abs(p - 1.0) < 1e-9 for p in p99s)
+    # tiny series degrade to fewer windows, never crash
+    assert len(bench._window_p99s([1.0] * 25, n_windows=20)) >= 1
+
+
+def test_drift_ok_on_flat_series(bench):
+    ok, head, tail = bench._soak_drift_ok([1.0] * 20)
+    assert ok and head == tail == 1.0
+
+
+def test_drift_fires_on_upward_trend(bench):
+    """A leak-shaped series — every late window slower — must fail."""
+    p99s = [1.0] * 10 + [1.0 + 0.5 * i for i in range(10)]
+    ok, head, tail = bench._soak_drift_ok(p99s)
+    assert not ok
+    assert tail > head
+
+
+def test_drift_shrugs_off_one_noisy_window(bench):
+    """One GC pause / noisy-neighbor window in the tail must NOT fail the
+    soak — the detector uses medians over the last 3 windows."""
+    p99s = [1.0] * 19 + [50.0]
+    ok, _, _ = bench._soak_drift_ok(p99s)
+    assert ok
+
+
+def test_drift_tolerates_tiny_series(bench):
+    ok, _, _ = bench._soak_drift_ok([1.0, 9.0])
+    assert ok  # below the resolution floor: no verdict, no false alarm
+
+
+# ---------------------------------------------------------------------------
+# the truncated soak itself: every gate green on a healthy build
+# ---------------------------------------------------------------------------
+
+def test_truncated_soak_all_gates_green(bench):
+    d = bench.run_endurance_soak(ticks=300, events_per_tick=100,
+                                 n_nodes=60, n_pods=900, n_classes=10,
+                                 firehose_ticks=20, firehose_events=1000)
+    assert d["soak_latency_flat"], d
+    assert d["soak_rss_flat"], d
+    assert d["soak_coalesce_ok"], d
+    assert d["soak_coalesce_ratio"] >= 100.0
+    # the 50k-events/s shape: every 1000-event window cost ONE delta
+    assert d["soak_firehose_ratio"] >= 1000.0
+    assert d["soak_overflows"] == 0
+    assert d["soak_tick_p99_ms"] > 0
+
+
+def test_soak_env_knobs(bench, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_SOAK_TICKS", "120")
+    monkeypatch.setenv("KARPENTER_TPU_SOAK_EVENTS_PER_TICK", "150")
+    d = bench.run_endurance_soak(n_nodes=40, n_pods=400, n_classes=8,
+                                 firehose_ticks=5, firehose_events=500)
+    assert d["soak_ticks"] == 120
+    assert d["soak_events_per_tick"] == 150
+    assert d["soak_coalesce_ratio"] >= 100.0
