@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -36,6 +36,13 @@ bench-drip: ## Steady-state drip: 50k-pod incremental-arena delta ticks vs full 
 
 bench-megafleet: ## 1M-pod partitioned solve: weak-scaling 1→8 shards + full-decode e2e (one JSON line)
 	python bench.py --megafleet
+
+bench-decode: ## Host-vs-device plan-assembly A/B at 2/4/8 shards, exact plan parity enforced (one JSON line)
+	python bench.py --decode
+
+decode-smoke: ## Truncated decode A/B gate (16k pods) + the decode parity/breaker suite (docs/performance.md)
+	JAX_PLATFORMS=cpu KARPENTER_TPU_MEGAFLEET_UNIT=2000 python bench.py --decode
+	$(PYTEST) tests/test_decode.py -q
 
 benchmark-interruption: ## Interruption controller throughput (100/1k/5k/15k messages)
 	python benchmarks/interruption_benchmark.py

@@ -17,6 +17,7 @@ bounded subprocess, then re-execs itself with `--run` under the chosen
 environment; if the TPU is unusable it falls back to the CPU platform with
 a one-line diagnostic and a "platform" field in the JSON."""
 
+import gc
 import json
 import os
 import subprocess
@@ -859,6 +860,155 @@ def run_megafleet(shard_counts=(1, 2, 4, 8), iters=3):
     return tail
 
 
+def _plan_fingerprint(problem, res):
+    """EXACT plan identity as comparable arrays: node option sequence,
+    per-node pod runs (order included), existing fills in dict insertion
+    order, unschedulable sequence, float total.  Any drift between the
+    host and device assemblers shows up as an array inequality."""
+    oi = {id(o): j for j, o in enumerate(problem.options)}
+    opts = np.asarray([oi[id(nd.option)] for nd in res.nodes], np.int64)
+    sizes = np.asarray([len(nd.pod_indices) for nd in res.nodes], np.int64)
+    pods = (np.concatenate([np.asarray(nd.pod_indices, np.int64)
+                            for nd in res.nodes])
+            if res.nodes else np.zeros(0, np.int64))
+    ex = np.asarray(list(res.existing_assignments.items()),
+                    np.int64).reshape(-1, 2)
+    uns = np.asarray(res.unschedulable, np.int64)
+    return opts, sizes, pods, ex, uns, res.total_price
+
+
+def run_decode_ab(shard_counts=(2, 4, 8), iters=3):
+    """`make bench-decode`: the host-vs-device plan-assembly A/B
+    (ROADMAP item 2, the DeviceDecode tentpole).
+
+    At every shard width the full-decode megafleet e2e — residual
+    classes in — runs both ways over the same partition plan: the legacy
+    host walk (`_assemble_plan`) against the slab path (on-device
+    argsort + columnar host assembly).  A timing is believed only after
+    (a) `_plan_fingerprint` equality — node order, pod order, dict
+    insertion order, float total — and (b) the decode counters confirm
+    the device run actually took the slab path (a silent fallback would
+    bench the host twice).  Headline: device-path e2e p50 at the widest
+    mesh; acceptance <500ms at 8 shards / ~1M pods (host ~4.1s)."""
+    import jax
+    from karpenter_tpu.parallel import make_pod_mesh, solve_partitioned
+    from karpenter_tpu.parallel.partition import plan_partition
+    from karpenter_tpu.utils import metrics, tracing
+
+    n_dev = len(jax.devices())
+    dsolves = metrics.decode_solves()
+    tr = tracing.TRACER
+    prev_enabled, prev_slow = tr.enabled, tr.slow_ms
+    tr.enabled, tr.slow_ms = True, 0.0
+    curve, phase_tail = [], {}
+    for n in shard_counts:
+        if n > n_dev:
+            log(f"[decode-ab-{n}] skipped: only {n_dev} devices visible")
+            continue
+        prob = _megafleet_problem(n)
+        total = int(prob.class_counts.sum())
+        mesh = make_pod_mesh(n)
+        plan = plan_partition(prob, n)
+        assert plan is not None, f"planner refused the {n}-unit megafleet"
+
+        def solve(device_decode):
+            return solve_partitioned(prob, mesh=mesh, decode=True,
+                                     max_nodes_per_shard=4096, plan=plan,
+                                     device_decode=device_decode)
+
+        fps = {}
+        times = {False: [], True: []}
+        phases = {False: {}, True: {}}
+        for dd in (False, True):
+            solve(dd)  # warm: jit compile + memo fills are not the claim
+        for i in range(iters):
+            # interleaved so machine-load drift lands on both sides
+            for dd in (False, True):
+                before_dev = dsolves.value({"path": "driver",
+                                            "outcome": "device"})
+                before_fb = dsolves.value({"path": "driver",
+                                           "outcome": "fallback"})
+                tr.reset()
+                # collect outside / disable inside the timed region:
+                # earlier widths leave the collector mid-cycle, and a
+                # gen-2 pass landing inside one side of the A/B would
+                # charge allocator noise to whichever path drew it
+                gc.collect()
+                gc.disable()
+                t0 = time.perf_counter()
+                try:
+                    with tr.span("bench.megafleet"):
+                        res = solve(dd)
+                    times[dd].append((time.perf_counter() - t0) * 1000.0)
+                finally:
+                    gc.enable()
+                for t in tr.traces():
+                    if t["name"] == "bench.megafleet":
+                        for c in t["children"]:
+                            _collect_phases(c, phases[dd])
+                if dd:
+                    assert dsolves.value({"path": "driver",
+                                          "outcome": "device"}) == \
+                        before_dev + 1, "device decode did not engage"
+                    assert dsolves.value({"path": "driver",
+                                          "outcome": "fallback"}) == \
+                        before_fb, "device decode silently fell back"
+                fps[dd] = _plan_fingerprint(prob, res)
+            h, d = fps[False], fps[True]
+            parity = (all(np.array_equal(a, b)
+                          for a, b in zip(h[:5], d[:5]))
+                      and h[5] == d[5])
+            assert parity, f"device plan diverged from host at n={n}"
+        entry = {
+            "shards": n, "pods": total,
+            "host_e2e_p50_ms": round(float(np.percentile(times[False], 50)), 1),
+            "host_e2e_p95_ms": round(float(np.percentile(times[False], 95)), 1),
+            "device_e2e_p50_ms": round(float(np.percentile(times[True], 50)), 1),
+            "device_e2e_p95_ms": round(float(np.percentile(times[True], 95)), 1),
+            "plan_parity": True,
+        }
+        entry["speedup"] = round(
+            entry["host_e2e_p50_ms"] / entry["device_e2e_p50_ms"], 3) \
+            if entry["device_e2e_p50_ms"] else None
+        curve.append(entry)
+        # keep only the driver's shard.* spans: the residual reconcile
+        # nests a full single-device solve whose solve.kernel/tensorize
+        # spans would collide with the mesh phases under _PHASE_KEYS
+        phase_tail = {}
+        phase_tail.update(_phase_stats(
+            {k: v for k, v in phases[False].items()
+             if k.startswith("shard.")},
+            prefix="megafleet_decode_host"))
+        phase_tail.update(_phase_stats(
+            {k: v for k, v in phases[True].items()
+             if k.startswith("shard.")},
+            prefix="megafleet_decode_device"))
+        log(f"[decode-ab-{n}] pods={total} "
+            f"host={entry['host_e2e_p50_ms']}ms "
+            f"device={entry['device_e2e_p50_ms']}ms "
+            f"speedup={entry['speedup']}x parity=ok")
+    tr.enabled, tr.slow_ms = prev_enabled, prev_slow
+
+    top = curve[-1] if curve else {}
+    tail = {
+        "metric": f"megafleet {top.get('shards', 0)}-shard full-decode "
+                  f"e2e p50, device path (host vs device A/B, equal "
+                  f"plans)",
+        "value": top.get("device_e2e_p50_ms"),
+        "unit": "ms",
+        # acceptance: <500ms at the widest mesh → vs_baseline >= 1.0
+        "vs_baseline": round(500.0 / top["device_e2e_p50_ms"], 3)
+        if top.get("device_e2e_p50_ms") else None,
+        "megafleet_decode_e2e_ms": top.get("device_e2e_p50_ms"),
+        "megafleet_decode_host_e2e_ms": top.get("host_e2e_p50_ms"),
+        "megafleet_decode_ab": curve,
+        "megafleet_decode_shard_counts": [c["shards"] for c in curve],
+        "host_cores": os.cpu_count(),
+    }
+    tail.update(phase_tail)
+    return tail
+
+
 def _backend_fields(platform):
     """Backend provenance for every JSON tail: what the orchestrator asked
     for (`auto` = subprocess discovery), what the child actually ran on,
@@ -933,7 +1083,7 @@ def _run_child(env, timeout=3000):
     bench = os.path.abspath(__file__)
     args = [sys.executable, bench, "--run"]
     for flag in ("--smoke", "--consolidation", "--sim", "--forecast",
-                 "--drip", "--megafleet", "--soak"):
+                 "--drip", "--megafleet", "--soak", "--decode"):
         if flag in sys.argv[1:]:
             args.append(flag)
     try:
@@ -956,9 +1106,11 @@ def main():
     requested = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() \
         or "auto"
     os.environ["KARPENTER_TPU_BENCH_REQUESTED"] = requested
-    # the megafleet stage needs a mesh: 8 virtual CPU devices whenever the
-    # backend resolves to cpu (a real TPU env brings its own chips)
-    megafleet = "--megafleet" in sys.argv[1:]
+    # the megafleet and decode-A/B stages need a mesh: 8 virtual CPU
+    # devices whenever the backend resolves to cpu (a real TPU env brings
+    # its own chips)
+    megafleet = ("--megafleet" in sys.argv[1:]
+                 or "--decode" in sys.argv[1:])
     plat = _probe_backend()
     if plat is not None:
         log(f"backend probe: {plat} ok")
@@ -982,7 +1134,7 @@ def main():
 
 
 def run_all(smoke=False, consolidation=False, sim=False, forecast=False,
-            drip=False, megafleet=False, soak=False):
+            drip=False, megafleet=False, soak=False, decode_ab=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
@@ -1007,6 +1159,12 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False,
                 f"rss_flat={d['soak_rss_flat']} "
                 f"coalesce_ok={d['soak_coalesce_ok']}")
             sys.exit(1)
+        return
+
+    if decode_ab:
+        # `make bench-decode`: host-vs-device plan assembly A/B across
+        # shard widths, exact plan parity enforced before any timing counts
+        _emit(run_decode_ab(), platform)
         return
 
     if megafleet:
@@ -1169,6 +1327,7 @@ if __name__ == "__main__":
                 forecast="--forecast" in sys.argv[1:],
                 drip="--drip" in sys.argv[1:],
                 megafleet="--megafleet" in sys.argv[1:],
-                soak="--soak" in sys.argv[1:])
+                soak="--soak" in sys.argv[1:],
+                decode_ab="--decode" in sys.argv[1:])
     else:
         main()

@@ -163,12 +163,13 @@ def iter_sources(root: str,
 
 def default_checkers() -> List[Checker]:
     from .arena import ArenaDisciplineChecker
+    from .decodepath import DecodePathChecker
     from .determinism import DeterminismChecker
     from .jaxhot import JaxHotPathChecker
     from .locks import LockDisciplineChecker
     from .observability import ObservabilityChecker
     from .robustness import RobustnessChecker
-    return [JaxHotPathChecker(), DeterminismChecker(),
+    return [JaxHotPathChecker(), DecodePathChecker(), DeterminismChecker(),
             LockDisciplineChecker(), ObservabilityChecker(),
             ArenaDisciplineChecker(), RobustnessChecker()]
 

@@ -22,6 +22,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from ..api import labels as wk
 from ..api.objects import Node, NodeClaim, NodePool, Pod, pool_view
 from ..api.requirements import IN, Requirement, Requirements
@@ -66,19 +68,58 @@ class ProvisioningResult:
         return self.bound_existing + self.bound_new
 
 
+def _pod_class_map(problem) -> np.ndarray:
+    """pod index → class id, built once per Problem (cached on it)."""
+    m = getattr(problem, "_pod_class_map", None)
+    if m is None:
+        m = np.empty(len(problem.pods), np.int64)
+        for ci, arr in enumerate(problem.members_arrays()):
+            m[arr] = ci
+        problem._pod_class_map = m
+    return m
+
+
+def claim_requests_columnar(problem, pod_indices: Sequence[int]) -> ResourceList:
+    """One claim's request total as a CLASS-block sum (the DeviceDecode
+    columnar NodeClaim path): pods in a tensorize class share one request
+    spec, so the total folds count × value per class instead of allocating
+    a ResourceList per pod — O(classes-per-node × keys), not O(pods).
+
+    Matches the legacy sequential merge exactly for integer canonical
+    quantities (n × int ≡ n sequential adds) with the legacy first-seen
+    key order (every pod of a class carries the same key set, so
+    first-seen-over-pods equals first-seen-over-classes)."""
+    idx = np.asarray(pod_indices, np.int64)
+    cseq = _pod_class_map(problem)[idx]
+    _, first, cnt = np.unique(cseq, return_index=True, return_counts=True)
+    requests = ResourceList()
+    for j in np.argsort(first, kind="stable").tolist():
+        rep = problem.pods[int(idx[first[j]])].requests
+        n = int(cnt[j])
+        for k, v in rep.items():
+            requests[k] = requests.get(k, 0) + n * v
+    requests[PODS] = requests.get(PODS, 0) + len(idx)
+    return requests
+
+
 def claim_from_decision(decision: NodeDecision, pods: Sequence[Pod],
-                        pools: Dict[str, NodePool]) -> NodeClaim:
+                        pools: Dict[str, NodePool],
+                        requests: Optional[ResourceList] = None) -> NodeClaim:
     """NodeDecision → NodeClaim with flexible candidates encoded as
     requirements (the shape CloudProvider.Create consumes,
-    /root/reference/pkg/cloudprovider/cloudprovider.go:92-118)."""
+    /root/reference/pkg/cloudprovider/cloudprovider.go:92-118).
+
+    `requests` short-circuits the per-pod merge when the caller already
+    built the total columnar-wise (claim_requests_columnar)."""
     opt = decision.option
     pool = pools[opt.pool]
     alt_types = [a.instance_type for a in decision.alternatives] or [opt.instance_type]
     alt_zones = sorted({a.zone for a in decision.alternatives} | {opt.zone})
-    requests = ResourceList()
-    for p in pods:
-        requests = requests + p.requests
-    requests[PODS] = requests.get(PODS, 0) + len(pods)
+    if requests is None:
+        requests = ResourceList()
+        for p in pods:
+            requests = requests + p.requests
+        requests[PODS] = requests.get(PODS, 0) + len(pods)
     claim = NodeClaim(
         nodepool=opt.pool,
         # pool requirements ∩ the decision's flexible candidate lists — a
@@ -113,7 +154,9 @@ class Provisioner:
                  provenance=None,
                  sharded_solve: bool = False,
                  health=None,
-                 watchdog_timeout_s: float = 0.0):
+                 watchdog_timeout_s: float = 0.0,
+                 device_decode: bool = False,
+                 decode_health=None):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
@@ -152,6 +195,17 @@ class Provisioner:
                                                 refinery=self.refinery)
         else:
             self._classpack = solve_classpack
+        # DeviceDecode feature gate: kernel emits the slot-sorted slab and
+        # the host assembles plans/NodeClaims columnar-wise (ops/decode.py).
+        # The DecodeHealth breaker demotes a failing slab path back to host
+        # assembly with a counted outcome; it is snapshot-registered
+        # (state/snapshot.py section "decode").
+        self.device_decode = bool(device_decode)
+        self.decode_health = decode_health
+        if self.device_decode:
+            self._classpack = functools.partial(
+                self._classpack, device_decode=True,
+                decode_health=decode_health)
 
     def _pick_solver(self, problem: Problem, n_existing: int = 0):
         """The flagship class-granular kernel IS the provisioning hot path —
@@ -213,6 +267,8 @@ class Provisioner:
             result = maybe_solve_partitioned(
                 problem, path="provisioning",
                 max_nodes=self.max_nodes_per_round,
+                device_decode=self.device_decode,
+                decode_health=self.decode_health,
                 **(dict(kw, node_list=existing[0])
                    if existing is not None else {}))
             if result is not None:
@@ -460,7 +516,11 @@ class Provisioner:
                     if not decision.pod_indices:
                         continue
                 dpods = [orig(problem.pods[i]) for i in decision.pod_indices]
-                claim = claim_from_decision(decision, dpods, self.nodepools)
+                creq = (claim_requests_columnar(problem,
+                                                decision.pod_indices)
+                        if self.device_decode else None)
+                claim = claim_from_decision(decision, dpods, self.nodepools,
+                                            requests=creq)
                 try:
                     claim = self.provider.create(claim)
                 except InsufficientCapacityError as e:

@@ -359,6 +359,13 @@ def build_controllers(op: Operator) -> Dict[str, object]:
     from ..ops.health import SolverHealth
     health = SolverHealth(clock=op.clock)
     solve_timeout = float(getattr(op.options, "solve_timeout_s", 0.0) or 0.0)
+    # the DecodeHealth breaker rides the same injected clock as the solver
+    # ladder so its demotion windows are deterministic under the sim; it is
+    # snapshot-registered (state/snapshot.py section "decode")
+    decode_health = None
+    if op.options.gate("DeviceDecode"):
+        from ..ops.decode import DecodeHealth
+        decode_health = DecodeHealth(clock=op.clock)
     provisioner = Provisioner(
         op.cloud_provider, op.cluster, op.nodepools,
         lp_guide=op.options.gate("LPGuide"),
@@ -367,7 +374,9 @@ def build_controllers(op: Operator) -> Dict[str, object]:
         provenance=op.provenance,
         sharded_solve=op.options.gate("ShardedSolve"),
         health=health,
-        watchdog_timeout_s=solve_timeout)
+        watchdog_timeout_s=solve_timeout,
+        device_decode=op.options.gate("DeviceDecode"),
+        decode_health=decode_health)
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {

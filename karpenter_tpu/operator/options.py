@@ -84,13 +84,21 @@ class Options:
     # delta application per tick — off by default; enable with
     # --ingest-batch or --feature-gates IngestBatch=true.  Overflow past
     # --ingest-max-events degrades to a full rebuild, never drops events.
+    # DeviceDecode: emit the pod→node plan as a slot-sorted slab ON
+    # DEVICE and assemble NodeClaims with columnar NumPy (ops/decode.py)
+    # instead of the per-pod host walk — off by default; enable with
+    # --device-decode or --feature-gates DeviceDecode=true.  Plans are
+    # bit-identical; a slab failure falls back to host assembly with a
+    # counted outcome under a DecodeHealth breaker (docs/performance.md
+    # "decode latency").
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
                                  "LPRefinery": False, "Forecast": False,
                                  "IncrementalArena": True,
                                  "ShardedSolve": False,
                                  "WarmRestart": False,
-                                 "IngestBatch": False})
+                                 "IngestBatch": False,
+                                 "DeviceDecode": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -201,6 +209,12 @@ class Options:
                             "mesh by zone-compatibility group (shorthand "
                             "for --feature-gates ShardedSolve=true; "
                             "no-op on <2 devices)")
+        p.add_argument("--device-decode", action="store_true",
+                       default=False,
+                       help="assemble pod→node plans from a device-sorted "
+                            "slab with columnar NumPy instead of the "
+                            "per-pod host loop (shorthand for "
+                            "--feature-gates DeviceDecode=true)")
         p.add_argument("--supervisor-circuit-threshold", type=int,
                        default=env.get("supervisor_circuit_threshold", 5),
                        help="consecutive reconcile errors before a "
@@ -319,6 +333,8 @@ class Options:
             opts.feature_gates["IncrementalArena"] = True
         if ns.sharded_solve:
             opts.feature_gates["ShardedSolve"] = True
+        if ns.device_decode:
+            opts.feature_gates["DeviceDecode"] = True
         if ns.warm_restart:
             opts.feature_gates["WarmRestart"] = True
         if ns.ingest_batch:

@@ -21,6 +21,7 @@ O(P × nodes × types) pointer-chasing loop.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -30,9 +31,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..api.resources import ResourceList
-from ..utils import tracing
+from ..utils import metrics, tracing
 from .ffd import SCORE_CAP, NodeDecision, PackingResult
 from .tensorize import LaunchOption, Problem, pad_to
+
+log = logging.getLogger("karpenter_tpu.classpack")
 
 _BIG = np.int32(2**30)
 
@@ -255,6 +258,59 @@ def class_pack_assign_kernel_fresh(requests, counts, compat_packed,
     return class_pack_assign_kernel(requests, counts, compat_packed,
                                     node_cap, alloc, price, rank,
                                     init_option, init_used, max_nodes, n_pods)
+
+
+@partial(jax.jit, static_argnames=("max_nodes", "n_pods"))
+def class_pack_assign_slab_kernel(requests, counts, compat_packed, node_cap,
+                                  alloc, price, rank, init_option, init_used,
+                                  max_nodes: int, n_pods: int):
+    """Assign kernel + on-device SLAB emission for vectorized decode.
+
+    The slab is the pod→node plan in the exact shape the columnar host
+    assembler (ops/decode.py) consumes: row ids stable-sorted by slot
+    (`order`), per-slot run lengths (`slot_counts`), and the slot→option
+    column.  Sorting on device means the host never touches a per-pod
+    value again — every decode artifact becomes a gather over `order`.
+
+    Unscheduled AND padded rows (class_ids saturate to C-1 past the real
+    pod count, rank >= totals) both carry assignment -1; they sort to the
+    back under key=K, and because the sort is stable the real unscheduled
+    rows (index < P) stay in row order AHEAD of padding (index >= P) — so
+    order[S:S+u] is exactly the legacy unschedulable list.  The K+1-bin
+    scatter gives the overflow key an explicit bin instead of relying on
+    out-of-bounds drop semantics; it is sliced off before shipping."""
+    assignment, slot_option, n_unsched = class_pack_assign_kernel(
+        requests, counts, compat_packed, node_cap, alloc, price, rank,
+        init_option, init_used, max_nodes, n_pods)
+    K = max_nodes
+    a = assignment.astype(jnp.int32)
+    key = jnp.where(a >= 0, a, K)
+    if (K + 1) * n_pods < 2**31:
+        # stable sort via a single-operand sort of the composite
+        # key*P + row: unique values, (key, row)-lexicographic, so the
+        # sorted residue IS the stable order — ~5x faster than the
+        # two-operand comparator sort argsort lowers to on CPU
+        comp = key * n_pods + jnp.arange(n_pods, dtype=jnp.int32)
+        order = (jnp.sort(comp) % n_pods).astype(jnp.int32)
+    else:
+        order = jnp.argsort(key).astype(jnp.int32)
+    slot_counts = jnp.zeros((K + 1,), jnp.int32).at[key].add(1)[:K]
+    return order, slot_counts, slot_option, n_unsched
+
+
+@partial(jax.jit, static_argnames=("max_nodes", "n_pods"))
+def class_pack_assign_slab_kernel_fresh(requests, counts, compat_packed,
+                                        node_cap, alloc, price, rank,
+                                        max_nodes: int, n_pods: int):
+    """Slab kernel with NO pre-opened slots (init state materializes on
+    device, same rationale as the other *_fresh variants)."""
+    R = alloc.shape[1]
+    init_option = jnp.full((max_nodes,), -1, jnp.int32)
+    init_used = jnp.zeros((max_nodes, R), jnp.int32)
+    return class_pack_assign_slab_kernel(requests, counts, compat_packed,
+                                         node_cap, alloc, price, rank,
+                                         init_option, init_used,
+                                         max_nodes, n_pods)
 
 
 @partial(jax.jit, static_argnames=("max_nodes",))
@@ -551,8 +607,23 @@ def solve_classpack(problem: Problem,
                     decode: bool = True,
                     max_alternatives: int = 60,
                     guide: Optional[str] = "lp",
-                    refinery=None) -> PackingResult:
+                    refinery=None,
+                    device_decode: bool = False,
+                    decode_health=None) -> PackingResult:
     """Host wrapper: sort classes → pad → kernel → decode.
+
+    device_decode=True (the `DeviceDecode` gate) routes batches at or
+    above ops/decode.DEVICE_DECODE_FLOOR through the slab kernel: the
+    pod→slot sort happens on device and the host assembles the plan with
+    column operations (ops/decode.assemble_slab_single) — bit-identical
+    output, no per-pod Python.  A slab-assembly failure reconstructs the
+    legacy assignment vector from the slab (no kernel re-dispatch) and
+    falls back to this decoder, counted in karpenter_decode_solves_total
+    and reported to `decode_health` (ops/decode.DecodeHealth) so a
+    persistently bad device path demotes instead of retrying every tick.
+    Guided fresh solves (guide="lp") are intercepted by solve_guided
+    before the kernel and keep the legacy decode; fleet-scale batches
+    reach the slab through the sharded driver instead.
 
     With decode=False only aggregate state is materialized (bench path:
     node count + total price, no per-pod binding).
@@ -672,20 +743,39 @@ def solve_classpack(problem: Problem,
         return PackingResult(nodes=nodes, unschedulable=[None] * n_unsched,
                              existing_assignments={}, total_price=total)
 
+    from . import decode as decode_mod
+    use_slab = bool(device_decode) and P >= decode_mod.DEVICE_DECODE_FLOOR
+    if use_slab and decode_health is not None and not decode_health.allow():
+        use_slab = False
+        metrics.decode_solves().inc({"path": "classpack",
+                                     "outcome": "suppressed"})
+    elif device_decode and not use_slab:
+        metrics.decode_solves().inc({"path": "classpack", "outcome": "floor"})
+
     # kernel dispatch + the blocking device->host transfer
     with tracing.span("solve.kernel"):
         Ppad = pad_to(P)
-        if E == 0:
-            out = class_pack_assign_kernel_fresh(*pod_args, d_alloc, d_price,
-                                                 d_rank, K, Ppad)
+        if use_slab:
+            if E == 0:
+                out = class_pack_assign_slab_kernel_fresh(
+                    *pod_args, d_alloc, d_price, d_rank, K, Ppad)
+            else:
+                out = class_pack_assign_slab_kernel(
+                    *pod_args, d_alloc, d_price, d_rank, *init_args(),
+                    K, Ppad)
+            order_idx, slot_counts, slot_option, n_unsched = \
+                jax.device_get(out)
+            assignment = None
         else:
-            out = class_pack_assign_kernel(*pod_args, d_alloc, d_price, d_rank,
-                                           *init_args(), K, Ppad)
-        assignment, slot_option, n_unsched = jax.device_get(out)
+            if E == 0:
+                out = class_pack_assign_kernel_fresh(*pod_args, d_alloc,
+                                                     d_price, d_rank, K, Ppad)
+            else:
+                out = class_pack_assign_kernel(*pod_args, d_alloc, d_price,
+                                               d_rank, *init_args(), K, Ppad)
+            assignment, slot_option, n_unsched = jax.device_get(out)
     # everything below is host-side decode: rows -> NodeDecisions
     with tracing.span("solve.decode"):
-        assignment = np.asarray(assignment, dtype=np.int32)[:P]
-
         # rows follow the sorted-class order, members consumed in sequence —
         # the same walk the takes-based decode did, now fully vectorized
         members_arr = problem.members_arrays()
@@ -695,6 +785,29 @@ def solve_classpack(problem: Problem,
                                  problem.class_counts[order]) if C else \
             np.zeros(0, np.int64)
 
+        if use_slab:
+            try:
+                res = decode_mod.assemble_slab_single(
+                    problem, order_idx, slot_counts,
+                    np.asarray(slot_option), pod_idx, class_of_row, E, K,
+                    max_alternatives, P)
+                metrics.decode_solves().inc({"path": "classpack",
+                                             "outcome": "device"})
+                if decode_health is not None:
+                    decode_health.report_success()
+                return res
+            except Exception:
+                log.exception("slab decode failed; host assembly fallback")
+                metrics.decode_solves().inc({"path": "classpack",
+                                             "outcome": "fallback"})
+                if decode_health is not None:
+                    decode_health.report_failure("error")
+                # the kernel output is still good: rebuild the legacy
+                # assignment vector from the slab, no re-dispatch
+                assignment = decode_mod.slab_to_assignment(
+                    order_idx, slot_counts, Ppad, K)
+
+        assignment = np.asarray(assignment, dtype=np.int32)[:P]
         sched = assignment >= 0
         unschedulable = pod_idx[~sched].tolist()
         ex = sched & (assignment < E)

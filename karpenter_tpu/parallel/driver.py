@@ -47,7 +47,9 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.classpack import (class_pack_aggregate_kernel,
-                             class_pack_assign_kernel, solve_classpack)
+                             class_pack_assign_kernel,
+                             class_pack_assign_slab_kernel, solve_classpack)
+from ..ops import decode as decode_mod
 from ..ops.lpguide import _subproblem
 from ..ops.tensorize import Problem, pad_to
 from ..utils import metrics, tracing
@@ -132,6 +134,44 @@ _partitioned_assign_donate = partial(
     donate_argnums=(7, 8))(_assign_impl)
 
 
+def _assign_slab_impl(requests_sh, counts_sh, compat_packed_sh, node_cap_sh,
+                      alloc, price, rank, init_opt_sh, init_used_sh,
+                      max_nodes_per_shard: int, n_pods_shard: int,
+                      mesh: Mesh):
+    """DeviceDecode variant of `_assign_impl`: each shard ships the sorted
+    SLAB (row order + per-slot run lengths) instead of a raw per-row
+    assignment, so the host assembly is pure column ops (ops/decode)."""
+    axes = tuple(mesh.axis_names)
+    u = len(axes)
+
+    def shard_fn(req, cnt, comp, ncap, io, iu):
+        for _ in range(u):
+            req, cnt, comp = req[0], cnt[0], comp[0]
+            ncap, io, iu = ncap[0], io[0], iu[0]
+        order, slot_counts, slot_option, n_unsched = \
+            class_pack_assign_slab_kernel(
+                req, cnt, comp, ncap, alloc, price, rank, io, iu,
+                max_nodes_per_shard, n_pods_shard)
+        idx = (None,) * u
+        return (order[idx], slot_counts[idx], slot_option[idx],
+                n_unsched[idx])
+
+    fn = _shard_map(shard_fn, mesh=mesh,
+                    in_specs=(P(*axes),) * 6, out_specs=(P(*axes),) * 4)
+    return fn(requests_sh, counts_sh, compat_packed_sh, node_cap_sh,
+              init_opt_sh, init_used_sh)
+
+
+_partitioned_assign_slab = partial(
+    jax.jit,
+    static_argnames=("max_nodes_per_shard", "n_pods_shard",
+                     "mesh"))(_assign_slab_impl)
+_partitioned_assign_slab_donate = partial(
+    jax.jit,
+    static_argnames=("max_nodes_per_shard", "n_pods_shard", "mesh"),
+    donate_argnums=(7, 8))(_assign_slab_impl)
+
+
 def solve_partitioned(problem: Problem, mesh: Optional[Mesh] = None,
                       max_nodes_per_shard: int = 4096,
                       decode: bool = True,
@@ -141,12 +181,25 @@ def solve_partitioned(problem: Problem, mesh: Optional[Mesh] = None,
                       existing_zone: Optional[np.ndarray] = None,
                       plan: Optional[PartitionPlan] = None,
                       max_residual_frac: float = MAX_RESIDUAL_FRAC_DEFAULT,
-                      min_pods: int = MIN_PODS_DEFAULT):
+                      min_pods: int = MIN_PODS_DEFAULT,
+                      device_decode: bool = False,
+                      decode_health=None):
     """Partition-aware mesh solve.  Returns None when the planner finds
     no exploitable structure (caller falls back to the single-device
     path); otherwise a PackingResult (decode=True) or the aggregate
     (total_cost, nodes_per_option, unsched) tuple (decode=False, E==0
-    only — the psum cannot attribute fills to existing owners)."""
+    only — the psum cannot attribute fills to existing owners).
+
+    device_decode=True (the `DeviceDecode` gate) swaps the decode path's
+    kernel for the slab variant: each shard sorts its pod rows by slot ON
+    DEVICE and the host builds the plan with column operations
+    (ops/decode.assemble_slab_sharded) instead of `_assemble_plan`'s
+    per-pod walk — bit-identical plans, ~10x less host time at megafleet
+    sizes.  A slab-assembly failure rebuilds the legacy per-row
+    assignment from the already-fetched slab (no kernel re-dispatch),
+    runs `_assemble_plan`, counts the fallback, and reports to
+    `decode_health` so a persistently bad device path demotes instead of
+    retrying every tick."""
     mesh = mesh or make_pod_mesh()
     n = mesh.devices.size
     if n < 2:
@@ -269,14 +322,25 @@ def solve_partitioned(problem: Problem, mesh: Optional[Mesh] = None,
         return cost, nodes_per_option, unsched
 
     # ---- decode path ----
+    use_slab = bool(device_decode)
+    if use_slab and decode_health is not None and not decode_health.allow():
+        use_slab = False
+        metrics.decode_solves().inc({"path": "driver",
+                                     "outcome": "suppressed"})
     compat_packed = np.packbits(compat_sh, axis=2)
     P_shard = int(counts_sh.sum(axis=(1,)).max()) if n else 0
     Ppad = pad_to(max(P_shard, 1))
     shape = mesh.devices.shape
-    assign_fn = (_partitioned_assign if jax.default_backend() == "cpu"
-                 else _partitioned_assign_donate)
+    on_cpu = jax.default_backend() == "cpu"
+    if use_slab:
+        assign_fn = (_partitioned_assign_slab if on_cpu
+                     else _partitioned_assign_slab_donate)
+    else:
+        assign_fn = (_partitioned_assign if on_cpu
+                     else _partitioned_assign_donate)
     with tracing.span("shard.solve") as sp:
-        sp.annotate(shards=n, classes_per_shard=Cs, slots=K, pods=Ppad)
+        sp.annotate(shards=n, classes_per_shard=Cs, slots=K, pods=Ppad,
+                    device_decode=use_slab)
         with tracing.span("shard.tensorize"):
             staged = (
                 jnp.asarray(requests_sh.reshape(*shape, Cpad, R)),
@@ -288,43 +352,120 @@ def solve_partitioned(problem: Problem, mesh: Optional[Mesh] = None,
                 jnp.asarray(init_opt.reshape(*shape, K)),
                 jnp.asarray(init_used.reshape(*shape, K, R)))
         with tracing.span("shard.kernel"):
-            out = assign_fn(*staged, K, Ppad, mesh)
-            assignment, slot_option, _unsched = jax.device_get(out)
+            tk = time.perf_counter()
+            if use_slab:
+                out = assign_fn(*staged, K, Ppad, mesh)
+                order_sh, slot_counts_sh, slot_option, _uns = \
+                    jax.device_get(out)
+                assignment = None
+                metrics.decode_duration().observe(
+                    time.perf_counter() - tk, {"phase": "kernel"})
+            else:
+                out = assign_fn(*staged, K, Ppad, mesh)
+                assignment, slot_option, _unsched = jax.device_get(out)
 
     # host decode: per-shard pod ids from whole-class membership (a class
     # lives entirely on its shard), then the shared assembly
     from ..ops.ffd import PackingResult
-    with tracing.span("shard.assemble"):
-        assignment = np.asarray(assignment).reshape(n, Ppad).astype(np.int32)
-        slot_option = np.asarray(slot_option).reshape(n, K)
-        members_arr = problem.members_arrays()
-        pod_parts, cls_parts, slot_parts = [], [], []
-        for s in range(n):
-            P_s = int(counts_sh[s].sum())
-            if P_s == 0:
-                continue
-            chunks, cls_ids = [], []
-            for pos, ci in enumerate(shard_cls[s]):
-                k = int(counts_sh[s, pos])
-                if k == 0:
+    result = used_add = None
+    if use_slab:
+        # columnar assembly: stitch the per-shard slabs shard-major — each
+        # shard's rows are already slot-sorted and shard s's global slots
+        # [s*K, (s+1)*K) precede shard s+1's, so the concatenation IS the
+        # global stable sort _assemble_plan would have computed
+        with tracing.span("shard.assemble"):
+            ta = time.perf_counter()
+            order_sh = np.asarray(order_sh).reshape(n, Ppad).astype(np.int64)
+            slot_counts_sh = np.asarray(slot_counts_sh).reshape(
+                n, K).astype(np.int64)
+            slot_option = np.asarray(slot_option).reshape(n, K)
+            members_arr = problem.members_arrays()
+            try:
+                pods_p, cls_p, slots_p, run_p, uns_p = [], [], [], [], []
+                for s in range(n):
+                    P_s = int(counts_sh[s].sum())
+                    if P_s == 0:
+                        continue
+                    chunks, cls_ids = [], []
+                    for pos, ci in enumerate(shard_cls[s]):
+                        k = int(counts_sh[s, pos])
+                        if k == 0:
+                            continue
+                        chunks.append(members_arr[ci][:k])
+                        cls_ids.append(np.full(k, ci, np.int64))
+                    pod_s = np.concatenate(chunks)
+                    cls_s = np.concatenate(cls_ids)
+                    ord_s, cnt_s = order_sh[s], slot_counts_sh[s]
+                    S_s = int(cnt_s.sum())
+                    take = ord_s[:S_s]
+                    pods_p.append(pod_s[take])
+                    cls_p.append(cls_s[take])
+                    # stable key-K sort keeps real unscheduled rows (< P_s)
+                    # ahead of padding, in row order
+                    uns_p.append(pod_s[ord_s[S_s:P_s]])
+                    occ = np.nonzero(cnt_s)[0]
+                    slots_p.append(occ + s * K)
+                    run_p.append(cnt_s[occ])
+
+                def cat(parts):
+                    return (np.concatenate(parts) if parts
+                            else np.zeros(0, np.int64))
+                result, used_add = decode_mod.assemble_slab_sharded(
+                    problem, cat(pods_p), cat(cls_p), cat(slots_p),
+                    cat(run_p), cat(uns_p), slot_option, O, K)
+                metrics.decode_duration().observe(
+                    time.perf_counter() - ta, {"phase": "assemble"})
+                metrics.decode_solves().inc({"path": "driver",
+                                             "outcome": "device"})
+                if decode_health is not None:
+                    decode_health.report_success()
+            except Exception:
+                log.exception("sharded slab assembly failed; falling back "
+                              "to host assembly")
+                metrics.decode_solves().inc({"path": "driver",
+                                             "outcome": "fallback"})
+                if decode_health is not None:
+                    decode_health.report_failure("error")
+                # the mesh output is still good: rebuild the per-row
+                # assignment from the slab, no kernel re-dispatch
+                assignment = np.stack([
+                    decode_mod.slab_to_assignment(
+                        order_sh[s], slot_counts_sh[s], Ppad, K)
+                    for s in range(n)])
+                result = None
+    if result is None:
+        with tracing.span("shard.assemble"):
+            assignment = np.asarray(assignment).reshape(
+                n, Ppad).astype(np.int32)
+            slot_option = np.asarray(slot_option).reshape(n, K)
+            members_arr = problem.members_arrays()
+            pod_parts, cls_parts, slot_parts = [], [], []
+            for s in range(n):
+                P_s = int(counts_sh[s].sum())
+                if P_s == 0:
                     continue
-                chunks.append(members_arr[ci][:k])
-                cls_ids.append(np.full(k, ci, np.int64))
-            pod_s = np.concatenate(chunks)
-            a_s = assignment[s, :P_s]
-            slot_parts.append(
-                np.where(a_s >= 0, a_s.astype(np.int64) + s * K, -1))
-            pod_parts.append(pod_s)
-            cls_parts.append(np.concatenate(cls_ids))
-        if pod_parts:
-            result, used_add = _assemble_plan(
-                problem, np.concatenate(pod_parts),
-                np.concatenate(cls_parts),
-                np.concatenate(slot_parts), slot_option, O, K)
-        else:
-            result, used_add = PackingResult(
-                nodes=[], unschedulable=[], existing_assignments={},
-                total_price=0.0), {}
+                chunks, cls_ids = [], []
+                for pos, ci in enumerate(shard_cls[s]):
+                    k = int(counts_sh[s, pos])
+                    if k == 0:
+                        continue
+                    chunks.append(members_arr[ci][:k])
+                    cls_ids.append(np.full(k, ci, np.int64))
+                pod_s = np.concatenate(chunks)
+                a_s = assignment[s, :P_s]
+                slot_parts.append(
+                    np.where(a_s >= 0, a_s.astype(np.int64) + s * K, -1))
+                pod_parts.append(pod_s)
+                cls_parts.append(np.concatenate(cls_ids))
+            if pod_parts:
+                result, used_add = _assemble_plan(
+                    problem, np.concatenate(pod_parts),
+                    np.concatenate(cls_parts),
+                    np.concatenate(slot_parts), slot_option, O, K)
+            else:
+                result, used_add = PackingResult(
+                    nodes=[], unschedulable=[], existing_assignments={},
+                    total_price=0.0), {}
     metrics.shard_solve_duration().observe(time.perf_counter() - t1,
                                            {"phase": "solve"})
 
@@ -340,11 +481,8 @@ def solve_partitioned(problem: Problem, mesh: Optional[Mesh] = None,
             if E:
                 # true leftovers: the mesh pass's fills are charged
                 # against each node's free space before the residual sees it
-                used2 = (existing_used.astype(np.float64).copy()
-                         if existing_used is not None
-                         else np.zeros((E, R), np.float64))
-                for eid in sorted(used_add):
-                    used2[eid] += used_add[eid]
+                used2 = decode_mod.merge_residual_used(
+                    existing_used, used_add, E, R)
                 r = solve_classpack(sub, max_nodes=max_nodes_per_shard,
                                     existing_alloc=existing_alloc,
                                     existing_used=used2,
@@ -369,7 +507,9 @@ def maybe_solve_partitioned(problem: Problem, *, path: str,
                             existing_alloc: Optional[np.ndarray] = None,
                             existing_used: Optional[np.ndarray] = None,
                             existing_compat: Optional[np.ndarray] = None,
-                            node_list: Optional[Sequence] = None):
+                            node_list: Optional[Sequence] = None,
+                            device_decode: bool = False,
+                            decode_health=None):
     """Controller entry: route a solve through the partitioned mesh when
     the ShardedSolve gate is on AND the batch/mesh justify it.  Returns
     None (with an outcome metric) whenever the caller should run its
@@ -391,7 +531,9 @@ def maybe_solve_partitioned(problem: Problem, *, path: str,
                                 existing_alloc=existing_alloc,
                                 existing_used=existing_used,
                                 existing_compat=existing_compat,
-                                existing_zone=existing_zone)
+                                existing_zone=existing_zone,
+                                device_decode=device_decode,
+                                decode_health=decode_health)
     except Exception:
         log.exception("partitioned solve failed; falling back to the "
                       "single-device path")

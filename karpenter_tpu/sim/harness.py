@@ -104,7 +104,8 @@ class SimHarness:
                  incremental_arena: Optional[bool] = None,
                  sharded_solve: Optional[bool] = None,
                  warm_restart: Optional[bool] = None,
-                 ingest_batch: Optional[bool] = None):
+                 ingest_batch: Optional[bool] = None,
+                 device_decode: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
         twice — knobs still come from the scenario's forecast block.
@@ -115,7 +116,10 @@ class SimHarness:
         with the gate off, so the default replay stays byte-identical.
         `warm_restart` / `ingest_batch` override the WarmRestart and
         IngestBatch gates (both default off) for the durability tests —
-        goldens are recorded with both off."""
+        goldens are recorded with both off.  `device_decode` overrides the
+        DeviceDecode gate (default off): columnar slab decode with
+        bit-identical plans, so gate-ON replays match the same goldens for
+        scenarios whose batches clear the decode floor."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -144,6 +148,8 @@ class SimHarness:
             opts.feature_gates["WarmRestart"] = bool(warm_restart)
         if ingest_batch is not None:
             opts.feature_gates["IngestBatch"] = bool(ingest_batch)
+        if device_decode is not None:
+            opts.feature_gates["DeviceDecode"] = bool(device_decode)
         fc = scenario.forecast
         fc_on = forecast if forecast is not None \
             else (fc is not None and fc.enabled)

@@ -96,6 +96,12 @@ def _health_of(manager) -> Optional[object]:
     return getattr(prov, "health", None) if prov is not None else None
 
 
+def _decode_health_of(manager) -> Optional[object]:
+    prov = manager.controllers.get("provisioning") \
+        if manager is not None else None
+    return getattr(prov, "decode_health", None) if prov is not None else None
+
+
 def collect_sections(op, manager=None) -> Dict:
     """Assemble the sections dict from a live operator (+ optional
     manager).  Caller holds the state lock; nothing here blocks."""
@@ -127,6 +133,9 @@ def collect_sections(op, manager=None) -> Dict:
         health = _health_of(manager)
         if health is not None:
             sections["health"] = health.snapshot_state()
+        dh = _decode_health_of(manager)
+        if dh is not None:
+            sections["decode"] = dh.snapshot_state()
     sections["meta"] = {
         "version": VERSION,
         "written_at": op.clock(),
@@ -279,6 +288,9 @@ def _apply_sections(sections: Dict, op, manager=None) -> None:
         health = _health_of(manager)
         if health is not None and "health" in sections:
             health.restore_state(sections["health"])
+        dh = _decode_health_of(manager)
+        if dh is not None and "decode" in sections:
+            dh.restore_state(sections["decode"])
 
 
 # ---------------------------------------------------------------------------

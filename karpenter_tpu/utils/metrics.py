@@ -534,6 +534,47 @@ def shard_solve_duration() -> Histogram:
         buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5, 15))
 
 
+def decode_solves() -> Counter:
+    """DeviceDecode routing: `device` (the slab assembled the plan),
+    `fallback` (slab assembly failed; the legacy host decoder rebuilt the
+    plan from the same kernel output), `suppressed` (the DecodeHealth
+    breaker is open — host assembly without trying), `floor` (batch below
+    ops/decode.DEVICE_DECODE_FLOOR).  Paths: `classpack` (single-device
+    solve) and `driver` (partitioned mesh solve)."""
+    return REGISTRY.counter(
+        "karpenter_decode_solves_total",
+        "Device-decode attempts by caller path and outcome.",
+        labels=("path", "outcome"))
+
+
+def decode_duration() -> Histogram:
+    """Device-decode phase latency: `kernel` (slab emission + transfer)
+    and `assemble` (columnar host assembly) — the breakdown that proves
+    the per-pod host loop left the critical path."""
+    return REGISTRY.histogram(
+        "karpenter_decode_duration_seconds",
+        "Device-decode phase duration.",
+        labels=("phase",),
+        buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5))
+
+
+def decode_demoted() -> Gauge:
+    """1 while the DecodeHealth breaker holds device decode demoted to
+    host assembly, 0 otherwise."""
+    return REGISTRY.gauge(
+        "karpenter_decode_demoted",
+        "Whether device decode is currently demoted to host assembly.")
+
+
+def decode_transitions() -> Counter:
+    """DecodeHealth breaker transitions: event `demoted` (reason `error`
+    or `timeout`) and `recovered` (half-open probe succeeded)."""
+    return REGISTRY.counter(
+        "karpenter_decode_transitions_total",
+        "Device-decode breaker transitions.",
+        labels=("event", "reason"))
+
+
 def trace_span_duration() -> Histogram:
     """Duration of every completed tracing span (utils/tracing.py), labeled
     by span name — the histogram the /debug/traces timeline feeds so

@@ -1,0 +1,304 @@
+"""Device-side decode (the DeviceDecode gate): EXACT plan parity of the
+columnar slab assemblers with the legacy per-pod decoders, the counted
+fallback + DecodeHealth breaker, the columnar NodeClaim request totals,
+and the breaker's snapshot round-trip.
+
+Parity here is stricter than test_partitioned's canonical comparison:
+the slab path is a bit-exact REWRITE of the same decode, so node order,
+pod order within a node, dict insertion order, alternatives, per-node
+used totals, and the float total_price must all be identical — `exact()`
+compares them verbatim, and `==` on total_price is deliberate."""
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod
+from karpenter_tpu.api.objects import NodePool
+from karpenter_tpu.api.resources import PODS, ResourceList
+from karpenter_tpu.ops import decode as dmod
+from karpenter_tpu.ops import solve_classpack, tensorize
+from karpenter_tpu.parallel import make_pod_mesh, solve_partitioned
+from karpenter_tpu.utils import metrics as m
+from test_partitioned import random_pinned_pods, zoned_catalog
+
+
+def exact(prob, res):
+    """Fully-ordered plan fingerprint: any byte of drift between the
+    legacy and slab decoders shows up as an inequality here."""
+    oi = {id(o): j for j, o in enumerate(prob.options)}
+    nodes = [(oi[id(nd.option)], list(nd.pod_indices),
+              dict(nd.used or {}),
+              tuple(oi[id(a)] for a in nd.alternatives))
+             for nd in res.nodes]
+    return (nodes, list(res.existing_assignments.items()),
+            list(res.unschedulable), res.total_price)
+
+
+def existing_capacity(prob, E=16):
+    """The shardable existing-node fixture from test_partitioned: zone-
+    derived compatibility, roomy 2x-max allocatable."""
+    Z = len(prob.zones)
+    ex_zone = (np.arange(E, dtype=np.int64) % Z)
+    big = prob.option_alloc.max(axis=0) * 2
+    ex_alloc = np.tile(big, (E, 1)).astype(np.float32)
+    ex_used = np.zeros_like(ex_alloc)
+    zone_1hot = np.zeros((prob.num_options, Z), bool)
+    zone_1hot[np.arange(prob.num_options), prob.option_zone] = True
+    ec = ((prob.class_compat @ zone_1hot) > 0)[:, ex_zone]
+    return ex_alloc, ex_used, ec, ex_zone
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# single-device slab parity (solve_classpack device_decode=True)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_single_device_parity_fresh(seed):
+    rng = np.random.default_rng(seed)
+    prob = tensorize(random_pinned_pods(rng), zoned_catalog(), [NodePool()])
+    host = solve_classpack(prob, guide=None)
+    before = m.decode_solves().value({"path": "classpack",
+                                      "outcome": "device"})
+    dev = solve_classpack(prob, guide=None, device_decode=True)
+    assert m.decode_solves().value({"path": "classpack",
+                                    "outcome": "device"}) == before + 1
+    assert exact(prob, dev) == exact(prob, host)
+
+
+def test_single_device_parity_existing():
+    rng = np.random.default_rng(4)
+    prob = tensorize(random_pinned_pods(rng, total=560), zoned_catalog(),
+                     [NodePool()])
+    ex_alloc, ex_used, ec, _ = existing_capacity(prob)
+    host = solve_classpack(prob, guide=None, existing_alloc=ex_alloc,
+                           existing_used=ex_used, existing_compat=ec)
+    dev = solve_classpack(prob, guide=None, existing_alloc=ex_alloc,
+                          existing_used=ex_used, existing_compat=ec,
+                          device_decode=True)
+    assert len(host.existing_assignments) > 0, "existing columns unused"
+    assert exact(prob, dev) == exact(prob, host)
+
+
+def test_single_device_floor_skips_slab():
+    """Batches under DEVICE_DECODE_FLOOR stay on the legacy path with a
+    counted `floor` outcome — and still produce the identical plan."""
+    prob = tensorize([cpu_pod() for _ in range(64)], zoned_catalog(),
+                     [NodePool()])
+    host = solve_classpack(prob, guide=None)
+    before = m.decode_solves().value({"path": "classpack",
+                                      "outcome": "floor"})
+    dev = solve_classpack(prob, guide=None, device_decode=True)
+    assert m.decode_solves().value({"path": "classpack",
+                                    "outcome": "floor"}) == before + 1
+    assert exact(prob, dev) == exact(prob, host)
+
+
+# ---------------------------------------------------------------------------
+# sharded slab parity (solve_partitioned device_decode=True)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sharded_parity_randomized(n_dev, seed):
+    """Device and host assembly of the same mesh output are identical at
+    every shard width.  (Width 1 has no mesh: the planner refuses and
+    the single-device tests above own that surface.)"""
+    rng = np.random.default_rng(seed)
+    prob = tensorize(random_pinned_pods(rng), zoned_catalog(), [NodePool()])
+    host = solve_partitioned(prob, mesh=make_pod_mesh(n_dev),
+                             max_nodes_per_shard=512, min_pods=1)
+    dev = solve_partitioned(prob, mesh=make_pod_mesh(n_dev),
+                            max_nodes_per_shard=512, min_pods=1,
+                            device_decode=True)
+    assert host is not None and dev is not None
+    assert exact(prob, dev) == exact(prob, host)
+
+
+def test_sharded_parity_residuals_and_existing():
+    """The hard composite: zone-free straddling pods (residual host
+    re-solve, merge_residual_used) + existing-node tucks, compared
+    exactly — including the node-major existing dict order."""
+    rng = np.random.default_rng(3)
+    pods = random_pinned_pods(rng, total=480)
+    free = [cpu_pod(cpu_m=700, mem_mib=512) for _ in range(24)]
+    prob = tensorize(pods + free, zoned_catalog(), [NodePool()])
+    ex_alloc, ex_used, ec, ex_zone = existing_capacity(prob)
+    kw = dict(max_nodes_per_shard=512, min_pods=1, existing_alloc=ex_alloc,
+              existing_used=ex_used, existing_compat=ec,
+              existing_zone=ex_zone)
+    host = solve_partitioned(prob, mesh=make_pod_mesh(8), **kw)
+    dev = solve_partitioned(prob, mesh=make_pod_mesh(8),
+                            device_decode=True, **kw)
+    assert host is not None and dev is not None
+    assert len(host.existing_assignments) > 0
+    assert exact(prob, dev) == exact(prob, host)
+    placed = [p for nd in dev.nodes for p in nd.pod_indices]
+    placed += list(dev.existing_assignments)
+    assert sorted(placed + list(dev.unschedulable)) == \
+        list(range(len(pods) + len(free)))
+
+
+# ---------------------------------------------------------------------------
+# fallback + breaker
+# ---------------------------------------------------------------------------
+
+def test_fallback_single_device_and_breaker_cycle(monkeypatch):
+    """Injected slab-assembly failure: identical plan off the same
+    kernel output (no re-dispatch), counted fallback, demotion after
+    two failures, suppressed while demoted, half-open probe after the
+    window, recovery on success."""
+    rng = np.random.default_rng(7)
+    prob = tensorize(random_pinned_pods(rng), zoned_catalog(), [NodePool()])
+    host = solve_classpack(prob, guide=None)
+    clk = FakeClock()
+    health = dmod.DecodeHealth(clock=clk)
+    real = dmod.assemble_slab_single
+
+    def boom(*a, **k):
+        raise RuntimeError("injected slab failure")
+
+    monkeypatch.setattr(dmod, "assemble_slab_single", boom)
+    before = m.decode_solves().value({"path": "classpack",
+                                      "outcome": "fallback"})
+    r1 = solve_classpack(prob, guide=None, device_decode=True,
+                         decode_health=health)
+    assert exact(prob, r1) == exact(prob, host)
+    assert m.decode_solves().value({"path": "classpack",
+                                    "outcome": "fallback"}) == before + 1
+    assert health.failures == 1 and health.demotions == 0
+
+    r2 = solve_classpack(prob, guide=None, device_decode=True,
+                         decode_health=health)
+    assert exact(prob, r2) == exact(prob, host)
+    assert health.demotions == 1 and not health.allow()
+
+    sup = m.decode_solves().value({"path": "classpack",
+                                   "outcome": "suppressed"})
+    r3 = solve_classpack(prob, guide=None, device_decode=True,
+                         decode_health=health)
+    assert exact(prob, r3) == exact(prob, host)
+    assert m.decode_solves().value({"path": "classpack",
+                                    "outcome": "suppressed"}) == sup + 1
+
+    # window expires → half-open probe; healthy assembly → recovery
+    monkeypatch.setattr(dmod, "assemble_slab_single", real)
+    clk.t += 61.0
+    r4 = solve_classpack(prob, guide=None, device_decode=True,
+                         decode_health=health)
+    assert exact(prob, r4) == exact(prob, host)
+    assert health.demotions == 0 and not health.probing
+    assert health.transitions.get("recovered:recovered") == 1
+
+
+def test_fallback_sharded(monkeypatch):
+    rng = np.random.default_rng(1)
+    prob = tensorize(random_pinned_pods(rng), zoned_catalog(), [NodePool()])
+    host = solve_partitioned(prob, mesh=make_pod_mesh(4),
+                             max_nodes_per_shard=512, min_pods=1)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected sharded slab failure")
+
+    monkeypatch.setattr(dmod, "assemble_slab_sharded", boom)
+    before = m.decode_solves().value({"path": "driver",
+                                      "outcome": "fallback"})
+    dev = solve_partitioned(prob, mesh=make_pod_mesh(4),
+                            max_nodes_per_shard=512, min_pods=1,
+                            device_decode=True)
+    assert m.decode_solves().value({"path": "driver",
+                                    "outcome": "fallback"}) == before + 1
+    assert dev is not None and host is not None
+    assert exact(prob, dev) == exact(prob, host)
+
+
+def test_decode_health_windows_and_snapshot_roundtrip():
+    clk = FakeClock()
+    h = dmod.DecodeHealth(clock=clk)
+    h.report_failure()
+    assert h.allow()                       # one failure: still promoted
+    h.report_failure()
+    assert h.demotions == 1
+    assert h.demoted_until == pytest.approx(clk.t + 60.0)
+    clk.t += 61.0
+    assert h.allow() and h.probing         # half-open probe
+    h.report_failure("error")              # probe fails → window doubles
+    assert h.demotions == 2
+    assert h.demoted_until == pytest.approx(clk.t + 120.0)
+
+    snap = h.snapshot_state()
+    h2 = dmod.DecodeHealth(clock=clk)
+    h2.restore_state(snap)
+    assert h2.snapshot_state() == snap
+    assert not h2.allow()
+    clk.t += 121.0
+    assert h2.allow() and h2.probing
+    h2.report_success()
+    assert h2.demotions == 0 and h2.failures == 0 and not h2.probing
+    assert h2.transitions.get("recovered:recovered") == 1
+    # the restored copy is independent state
+    assert h.transitions.get("recovered:recovered") is None
+
+
+def test_slab_to_assignment_inverse():
+    """The fallback bridge reproduces the legacy assignment vector from
+    the slab triplet."""
+    rng = np.random.default_rng(11)
+    K, P = 7, 40
+    assignment = rng.integers(-1, K, size=P).astype(np.int32)
+    key = np.where(assignment >= 0, assignment, K)
+    order = np.argsort(key, kind="stable")
+    counts = np.bincount(key, minlength=K + 1)[:K]
+    back = dmod.slab_to_assignment(order, counts, P, K)
+    assert (back == assignment).all()
+
+
+# ---------------------------------------------------------------------------
+# columnar NodeClaim requests + the controller gate end to end
+# ---------------------------------------------------------------------------
+
+def test_claim_requests_columnar_matches_legacy():
+    from karpenter_tpu.controllers.provisioning import (
+        claim_requests_columnar)
+    rng = np.random.default_rng(9)
+    prob = tensorize(random_pinned_pods(rng, total=320), zoned_catalog(),
+                     [NodePool()])
+    res = solve_classpack(prob, guide=None)
+    assert res.nodes
+    for nd in res.nodes:
+        legacy = ResourceList()
+        for i in nd.pod_indices:
+            legacy = legacy + prob.pods[i].requests
+        legacy[PODS] = legacy.get(PODS, 0) + len(nd.pod_indices)
+        col = claim_requests_columnar(prob, nd.pod_indices)
+        assert col == legacy
+        assert list(col) == list(legacy)   # first-seen key order too
+
+
+def test_provisioner_gate_parity():
+    """DeviceDecode through the real Provisioner: identical launch
+    decisions and claim request totals with the gate on and off."""
+    from karpenter_tpu.cloud import CloudProvider, FakeCloud
+    from karpenter_tpu.controllers import Provisioner
+    from karpenter_tpu.state import Cluster
+
+    def launch_plan(device_decode):
+        cloud = FakeCloud()
+        provider = CloudProvider(cloud, zoned_catalog())
+        cluster = Cluster()
+        rng = np.random.default_rng(6)
+        for p in random_pinned_pods(rng, total=600):
+            cluster.add_pod(p)
+        prov = Provisioner(provider, cluster, [NodePool()], lp_guide=False,
+                           device_decode=device_decode)
+        problem, result = prov.solve(cluster.pending_pods())
+        return exact(problem, result)
+
+    assert launch_plan(True) == launch_plan(False)

@@ -324,6 +324,43 @@ def test_golden_report_durability_gates_off(name, fname, duration):
             f"durability-gates-off report for {fname} diverged from {path}")
 
 
+@pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
+                         ids=[c[0] for c in GOLDEN_CASES])
+def test_golden_report_device_decode_gate_off(name, fname, duration):
+    """DeviceDecode defaults OFF; the explicit off-override must leave
+    every canned scenario's report byte-identical — the decode rewrite
+    cannot perturb a run that never takes the slab path."""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     device_decode=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"device_decode=off report for {fname} diverged from {path}")
+
+
+@pytest.mark.parametrize("name", ["diurnal", "spot-reclaim-storm"])
+def test_golden_report_device_decode_gate_on(name):
+    """DeviceDecode ON must never change WHAT a cluster does.  Goldens
+    are recorded gate-off; with the gate on, every sim batch sits under
+    the FFD native cutover / DEVICE_DECODE_FLOOR so the legacy decode
+    runs verbatim and the report is byte-identical.  (Above-floor
+    engagement parity — the slab path actually running — is pinned by
+    tests/test_decode.py, including the real-Provisioner 600-pod batch.)
+    """
+    nm, fname, duration = next(c for c in GOLDEN_CASES if c[0] == name)
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     device_decode=True).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{nm}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"device_decode=on report for {fname} diverged from {path}: "
+            f"the gate changed behavior, not just decode latency")
+
+
 def test_golden_report_ingest_batch_gate_on():
     """IngestBatch coalesces events between ticks but every flushed row
     re-derives from current cluster state through the same math as the

@@ -376,6 +376,43 @@ def test_chaos_restart_restores_circuits_ladder_and_ice(tmp_path):
     assert not op2.cluster.pending_pods()
 
 
+def test_chaos_restart_restores_decode_breaker(tmp_path):
+    """chaos × restart for the DeviceDecode breaker (snapshot section
+    "decode"): a demoted device-decode path stays demoted across a warm
+    restart in the same clock domain — the successor must not burn its
+    first fleet-scale tick re-discovering a failure the predecessor
+    already counted — and the doubling window still expires into the
+    half-open probe afterwards."""
+    clk = [1000.0]
+    path = str(tmp_path / "snap.bin")
+    clock = lambda: clk[0]
+    op, mgr = stack(clock, path, ("WarmRestart", "DeviceDecode"))
+    dh = mgr.controllers["provisioning"].decode_health
+    assert dh is not None, "DeviceDecode gate did not wire a DecodeHealth"
+    assert dh.clock is op.clock
+    dh.report_failure("error")
+    dh.report_failure("error")          # second failure → demoted, 60s
+    assert dh.demotions == 1 and not dh.allow()
+    assert write_snapshot(path, op, mgr)
+
+    op2, mgr2 = stack(clock, path, ("WarmRestart", "DeviceDecode"))
+    assert restore_snapshot(path, op2, mgr2) == "restored"
+    dh2 = mgr2.controllers["provisioning"].decode_health
+    assert dh2 is not None
+    assert dh2.snapshot_state() == dh.snapshot_state()
+    assert not dh2.allow()              # still demoted post-restore
+    clk[0] += 61.0
+    assert dh2.allow() and dh2.probing  # window expiry → half-open probe
+    dh2.report_success()
+    assert dh2.demotions == 0
+    assert dh2.transitions.get("recovered:recovered") == 1
+
+    # a gate-off successor restores cleanly past the orphan section
+    op3, mgr3 = stack(clock, path, ("WarmRestart",))
+    assert mgr3.controllers["provisioning"].decode_health is None
+    assert restore_snapshot(path, op3, mgr3) == "restored"
+
+
 def test_restart_mid_chaos_storm_converges(tmp_path):
     """Integration cut of satellite 4: random interruptions/ICE for a
     while, snapshot, 'kill' the operator (drop every object), restore a
