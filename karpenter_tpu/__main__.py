@@ -9,11 +9,29 @@ a real deployment swaps the substrate handles in `Operator`.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import sys
 
 from .operator import ControllerManager, Operator, Options, build_controllers
 from .utils.tracing import configure_logging
+
+
+def _build_leader(options):
+    """Leadership elector for --leader-elect: a TTL'd lease file shared by
+    the replicas on this host (charts' 2-replica HA analog).  The lease
+    carries the fencing epoch the HAFailover gate validates on every
+    snapshot/cloud write."""
+    if not options.leader_elect:
+        return None
+    import socket
+    import tempfile
+    from .operator.manager import LeaderElector
+    lease = options.lease_path or os.path.join(
+        tempfile.gettempdir(),
+        f"karpenter-{options.cluster_name}.lease")
+    identity = f"{socket.gethostname()}-{os.getpid()}"
+    return LeaderElector(lease, identity, ttl=options.lease_ttl_s)
 
 
 def main(argv=None) -> int:
@@ -22,14 +40,14 @@ def main(argv=None) -> int:
     options = Options.from_args(argv)
     configure_logging(options)
     op = Operator(options)
-    manager = ControllerManager(op, build_controllers(op))
+    manager = ControllerManager(op, build_controllers(op),
+                                leader=_build_leader(options))
+    # readiness ladder BEFORE serving: warm restore (hydration already
+    # rebuilt what it could from cloud tags; a valid snapshot supersedes
+    # it, any mismatch falls back cold), then the arena parity probe,
+    # then the role phase — /readyz stays 503 until the ladder completes
+    outcome = manager.startup()
     if options.gate("WarmRestart") and options.snapshot_path:
-        # warm restore AFTER construction: hydration already rebuilt what
-        # it could from cloud tags; a valid snapshot supersedes it with
-        # the full pre-crash working set (any mismatch falls back cold)
-        from .state.snapshot import restore_snapshot
-        with op.state_lock:
-            outcome = restore_snapshot(options.snapshot_path, op, manager)
         logging.info("warm restart: %s", outcome)
     port = manager.serve_endpoints()
     logging.info("karpenter-tpu up: cluster=%s endpoints=127.0.0.1:%s "

@@ -19,6 +19,13 @@ it and if its closed registries stay closed.  Three lexical rules:
     is not in `utils.watchdog.PHASES`.  Same two-way contract: the
     `karpenter_watchdog_trips_total{phase}` label set and the docs
     enumerate the registry.
+  * RS004 — a ``write_snapshot(...)`` call or a ``.create_fleet(`` /
+    ``.terminate_instances(`` attribute call outside the fence-checked
+    funnels (`state/snapshot.py`, `cloud/provider.py`,
+    `cloud/batcher.py`).  HA fencing (utils/fencing.py) only holds if
+    EVERY snapshot write and cloud mutation flows through a funnel that
+    validates the fencing epoch — a new call site elsewhere is an
+    unfenced write a deposed leader could still land.
 
 `operator/manager.py` and `operator/supervisor.py` are exempt from RS001
 — they ARE the supervision machinery (the manager's `_supervised` is the
@@ -47,10 +54,22 @@ rule("RS003", "robustness",
      "run_with_deadline phase not in the registered PHASES set",
      "add the phase to utils/watchdog.py PHASES (and the "
      "karpenter_watchdog_trips_total docs row) before using it")
+rule("RS004", "robustness",
+     "snapshot write / cloud mutation outside the fence-checked funnel",
+     "route the write through state/snapshot.py (SnapshotWriter or "
+     "write_snapshot with the manager's fence) or the cloud provider's "
+     "create/delete funnel — unfenced call sites let a deposed leader "
+     "mutate shared state after a newer epoch took over")
 
 _RS001_EXEMPT = frozenset({"karpenter_tpu/operator/manager.py",
                            "karpenter_tpu/operator/supervisor.py"})
 _SUPERVISED_CALLS = frozenset({"reconcile", "provision"})
+# the fence-checked funnels themselves: the only modules allowed to call
+# the raw snapshot/cloud mutation seams (RS004 keeps them closed)
+_RS004_EXEMPT = frozenset({"karpenter_tpu/state/snapshot.py",
+                           "karpenter_tpu/cloud/provider.py",
+                           "karpenter_tpu/cloud/batcher.py"})
+_RS004_CLOUD_CALLS = frozenset({"create_fleet", "terminate_instances"})
 
 
 def _points() -> frozenset:
@@ -96,6 +115,21 @@ def _is_chaos_inject(call: ast.Call) -> bool:
          else f.value.attr) == "CHAOS"
 
 
+def _rs004_escape(call: ast.Call) -> Optional[str]:
+    """The mutation seam this call escapes through, or None.  Both the
+    bare-name and module-qualified spellings of `write_snapshot` count;
+    the cloud seams are method calls on whatever holds the substrate."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "write_snapshot":
+        return "write_snapshot"
+    if isinstance(f, ast.Attribute):
+        if f.attr == "write_snapshot":
+            return "write_snapshot"
+        if f.attr in _RS004_CLOUD_CALLS:
+            return f.attr
+    return None
+
+
 def _is_run_with_deadline(call: ast.Call) -> bool:
     f = call.func
     name = f.id if isinstance(f, ast.Name) else \
@@ -129,6 +163,15 @@ class RobustnessChecker(Checker):
                                 f"supervisor — backoff/circuit/quarantine "
                                 f"never see it"))
             elif isinstance(node, ast.Call):
+                if sf.rel not in _RS004_EXEMPT:
+                    seam = _rs004_escape(node)
+                    if seam is not None:
+                        findings.append(Finding(
+                            "RS004", sf.rel, node.lineno,
+                            sf.scope_of(node), seam,
+                            f"{seam}() called outside the fence-checked "
+                            f"funnel — a deposed leader could land this "
+                            f"write with a stale fencing epoch"))
                 if _is_chaos_inject(node) and node.args:
                     point = _literal(node.args[0])
                     if point is not None and point not in points:

@@ -285,6 +285,10 @@ class CloudProvider:
         self.subnets = subnets
         self.launch_templates = launch_templates
         self._claims_by_provider_id: Dict[str, NodeClaim] = {}
+        # HAFailover fencing (utils/fencing.LeaseFence, attached by the
+        # ControllerManager): when set, the _create/_delete funnels refuse
+        # to mutate the cloud under a stale fencing epoch.  None = no HA.
+        self.fence = None
 
     # ---- catalog ----
     def get_instance_types(self, nodepool: Optional[NodePool] = None) -> List[InstanceType]:
@@ -352,6 +356,12 @@ class CloudProvider:
         /root/reference/pkg/providers/instance/instance.go:88-105)."""
         if not claim.created_at:
             claim.created_at = self.clock()
+        if self.fence is not None and not self.fence.check("launch"):
+            # deposed leader mid-tick: the new leader owns the substrate
+            # now — refuse (counted), never launch a ghost node
+            from ..utils.fencing import StaleFenceError
+            raise StaleFenceError(
+                f"stale fencing epoch: launch of {claim.name} refused")
         if self.breaker is not None and not self.breaker.allow():
             # fast-fail into the same path an all-ICE'd launch takes: the
             # claim fails, pending pods back off and re-solve later —
@@ -537,6 +547,11 @@ class CloudProvider:
     def _delete(self, claim: NodeClaim) -> None:
         if not claim.provider_id:
             return
+        if self.fence is not None and not self.fence.check("terminate"):
+            from ..utils.fencing import StaleFenceError
+            raise StaleFenceError(
+                f"stale fencing epoch: terminate of {claim.provider_id} "
+                "refused")
         done = self.cloud.terminate_instances([claim.provider_id])
         claim.terminating = True
         if not done:

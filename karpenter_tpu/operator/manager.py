@@ -78,8 +78,18 @@ class PodBatchWindow:
 
 
 class LeaderElector:
-    """File-lease leader election: acquire/renew a TTL'd lease file
-    (HA analog of the chart's leader-elected 2 replicas)."""
+    """File-lease leader election with fencing epochs: acquire/renew a
+    TTL'd lease file (HA analog of the chart's leader-elected 2 replicas).
+
+    The lease carries a monotone `epoch` that bumps on every acquisition
+    by a NEW leadership term (a different holder, an expired or corrupt
+    lease, or a restarted process re-winning its own old lease) and
+    stays fixed across renewals.  `holds_fence()` is the write-side
+    check: the lease must still name this process at the epoch it
+    acquired — the token every guarded snapshot/cloud mutation validates
+    (utils/fencing.py).  `release()` is the graceful-handover half:
+    expire our own lease in place so a standby promotes immediately
+    instead of waiting out the TTL."""
 
     def __init__(self, lease_path: str, identity: str, ttl: float = 15.0,
                  clock: Callable[[], float] = time.time):
@@ -87,6 +97,38 @@ class LeaderElector:
         self.identity = identity
         self.ttl = ttl
         self.clock = clock
+        # fencing state: epoch of OUR current leadership term (0 = never
+        # led); `_leading` is the last known verdict so acquire/lose
+        # transitions count exactly once per term
+        self._epoch = 0
+        self._leading = False
+        self.acquisitions = 0
+        self.losses = 0
+        self.releases = 0
+
+    def _read_lease(self) -> Optional[tuple]:
+        """(holder, renewed, epoch), or None when missing/corrupt — a
+        lease we cannot parse can never prove anyone's leadership."""
+        try:
+            with open(self.lease_path) as f:
+                lease = json.load(f)
+            return (str(lease["holder"]), float(lease["renewed"]),
+                    int(lease.get("epoch", 0)))
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _note_acquired(self, new_term: bool) -> None:
+        if new_term or not self._leading:
+            self.acquisitions += 1
+            metrics.leader_transitions().inc({"event": "acquired"})
+        self._leading = True
+        metrics.leader_fence_epoch().set(self._epoch)
+
+    def _note_lost(self) -> None:
+        if self._leading:
+            self._leading = False
+            self.losses += 1
+            metrics.leader_transitions().inc({"event": "lost"})
 
     def try_acquire(self) -> bool:
         """Read-decide-write under a kernel flock so two replicas racing at
@@ -95,6 +137,7 @@ class LeaderElector:
         crash mid-update can neither deadlock election nor leave a stale
         artifact another replica might delete out from under a live holder."""
         import fcntl
+        CHAOS.inject("leader.lease", key="acquire")
         lock = f"{self.lease_path}.lock"
         fd = os.open(lock, os.O_CREAT | os.O_WRONLY, 0o644)
         try:
@@ -103,30 +146,100 @@ class LeaderElector:
             except OSError:
                 return self.is_leader()  # someone else is mid-update
             now = self.clock()
-            try:
-                with open(self.lease_path) as f:
-                    lease = json.load(f)
-                if lease["holder"] != self.identity and \
-                        now - lease["renewed"] < self.ttl:
+            lease = self._read_lease()
+            # missing/corrupt lease: a NEW term past our own last epoch —
+            # corruption must never let the epoch regress (a stale token
+            # stamped under our old epoch would validate again)
+            renewal, epoch = False, self._epoch + 1
+            if lease is not None:
+                holder, renewed, cur_epoch = lease
+                valid = now - renewed < self.ttl
+                if holder == self.identity and valid and \
+                        cur_epoch == self._epoch and self._epoch > 0:
+                    renewal, epoch = True, cur_epoch  # uninterrupted term
+                elif holder != self.identity and valid:
+                    self._note_lost()
                     return False
-            except (OSError, ValueError, KeyError):
-                pass
+                else:
+                    # expired, corrupt-then-rewritten, or a previous
+                    # incarnation of ourselves: a NEW term begins — bump
+                    # the fencing epoch past everything either side has
+                    # seen, so anything stamped under an old one is
+                    # refusable forever
+                    epoch = max(cur_epoch, self._epoch) + 1
             tmp = f"{self.lease_path}.{self.identity}.tmp"
             with open(tmp, "w") as f:
-                json.dump({"holder": self.identity, "renewed": now}, f)
+                json.dump({"holder": self.identity, "renewed": now,
+                           "epoch": epoch}, f)
             os.replace(tmp, self.lease_path)
+            self._epoch = epoch
+            self._note_acquired(new_term=not renewal)
             return True
         finally:
             os.close(fd)  # closing the fd releases the flock
 
     def is_leader(self) -> bool:
+        lease = self._read_lease()
+        return lease is not None and lease[0] == self.identity and \
+            self.clock() - lease[1] < self.ttl
+
+    # ---- fencing surface (utils/fencing.LeaseFence delegates here) ----
+    def fence_epoch(self) -> int:
+        """Epoch of our current/last leadership term (0 = never led)."""
+        return self._epoch
+
+    def holds_fence(self) -> bool:
+        """True only while the lease still names us AT OUR EPOCH — the
+        strict form every guarded write validates.  A rival's interim
+        term (even one that already ended) shows up as an epoch ahead of
+        ours and correctly reads as stale."""
+        lease = self._read_lease()
+        return (lease is not None and self._epoch > 0
+                and lease[0] == self.identity
+                and lease[2] == self._epoch
+                and self.clock() - lease[1] < self.ttl)
+
+    def lease_remaining(self) -> float:
+        """Seconds of validity left on OUR lease (0 when deposed) — the
+        mid-tick guard's budget check."""
+        lease = self._read_lease()
+        if lease is None or lease[0] != self.identity or \
+                lease[2] != self._epoch:
+            return 0.0
+        return max(0.0, self.ttl - (self.clock() - lease[1]))
+
+    def release(self) -> bool:
+        """Graceful handover (the SIGTERM drain): rewrite our own lease
+        already-expired, epoch intact, so the standby's next acquire
+        succeeds immediately (and bumps the epoch past ours).  Failover
+        cost becomes one election round, not TTL + clock drift."""
+        import fcntl
+        CHAOS.inject("leader.lease", key="release")
+        lock = f"{self.lease_path}.lock"
+        fd = os.open(lock, os.O_CREAT | os.O_WRONLY, 0o644)
         try:
-            with open(self.lease_path) as f:
-                lease = json.load(f)
-            return lease["holder"] == self.identity and \
-                self.clock() - lease["renewed"] < self.ttl
-        except (OSError, ValueError, KeyError):
-            return False
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return False
+            lease = self._read_lease()
+            if lease is None or lease[0] != self.identity or \
+                    lease[2] != self._epoch or self._epoch == 0:
+                self._note_lost()   # nothing of ours left to release
+                return False
+            tmp = f"{self.lease_path}.{self.identity}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"holder": self.identity,
+                           "renewed": self.clock() - self.ttl,
+                           "epoch": self._epoch}, f)
+            os.replace(tmp, self.lease_path)
+            if self._leading:
+                self._leading = False
+                self.releases += 1
+                metrics.leader_transitions().inc({"event": "released"})
+            return True
+        finally:
+            os.close(fd)
 
 
 @dataclass
@@ -223,6 +336,33 @@ class ControllerManager:
                 operator.options.snapshot_path, operator, manager=self,
                 interval_s=getattr(operator.options,
                                    "snapshot_interval_s", 30.0))
+        # readiness lifecycle (STARTING→RESTORING→PROBING→{LEADING,STANDBY}
+        # →DRAINING): `startup()` walks the restore/probe phases once,
+        # `tick()` keeps the role phases honest afterwards.  /readyz flips
+        # only in LEADING/STANDBY; /healthz reports liveness independently.
+        self.phase = "STARTING"
+        self.phase_transitions: Dict[str, int] = {}
+        self.promotions = 0
+        self.restore_outcome = "none"
+        self.probe_outcome = "none"
+        self._lease_errors = 0
+        self._lease_err_streak = 0
+        self._midtick_aborts = 0
+        self._skipped_ticks = 0
+        metrics.ready_state().set(1, {"phase": self.phase})
+        # fenced leadership (utils/fencing.py, HAFailover gate): every
+        # snapshot write and cloud mutation validates the fencing epoch;
+        # without the gate (or without a leader) everything runs unfenced
+        # exactly as before
+        self.fence = None
+        if leader is not None and operator.options.gate("HAFailover"):
+            from ..utils.fencing import LeaseFence
+            self.fence = LeaseFence(leader)
+            cloud = getattr(operator, "cloud_provider", None)
+            if cloud is not None:
+                cloud.fence = self.fence
+            if self._snapshotter is not None:
+                self._snapshotter.fence = self.fence
 
     def _nodeclass_tick(self, ctrl):
         def run():
@@ -236,15 +376,43 @@ class ControllerManager:
         plus provisioning when the pod batch window is ripe.  Returns
         results per controller that ran."""
         if self.leader is not None:
-            self.leader.try_acquire()
-            if not self.leader.is_leader():
+            try:
+                self.leader.try_acquire()
+                leading = self.leader.is_leader()
+            except Exception as err:
+                # lease I/O failed (chaos or a sick disk): we cannot prove
+                # leadership, so this tick must not mutate anything.  One
+                # WARN per outage, not per tick — a blackout window would
+                # otherwise log thousands of identical tracebacks.
+                self._lease_errors += 1
+                metrics.leader_lease_errors().inc()
+                if self._lease_err_streak == 0:
+                    log.warning("lease I/O failed; skipping ticks until it "
+                                "recovers: %s", err)
+                self._lease_err_streak += 1
+                leading = False
+            else:
+                if self._lease_err_streak:
+                    log.info("lease I/O recovered after %d failed tick(s)",
+                             self._lease_err_streak)
+                self._lease_err_streak = 0
+            if not leading:
+                self._skipped_ticks += 1
+                if self.phase in ("STARTING", "LEADING"):
+                    self._set_phase("STANDBY")
                 return {}
+        if self.phase in ("STARTING", "STANDBY"):
+            self._enter_role_phase()
         with self._state_lock:
             return self._tick_locked()
 
     def _tick_locked(self) -> Dict[str, object]:
         now = self.clock()
         results: Dict[str, object] = {}
+        # mid-tick lease guard: waiting on the state lock may have eaten
+        # the whole lease; a deposed tick must abort before any mutation
+        if not self._lease_live():
+            return results
         # IngestBatch: the window of events absorbed since the last tick
         # lands as ONE arena delta before any controller reads the slab
         arena = getattr(self.operator.cluster, "arena", None)
@@ -285,9 +453,28 @@ class ControllerManager:
                           # resumes the moment the supervisor re-allows
             e.last_run = now
             self._supervised(now, e.name, e.reconcile, results)
-        if self._snapshotter is not None:
+        # re-check before the final mutating phase: the controller sweep
+        # above is the long part of a tick and can outlive the lease
+        if self._snapshotter is not None and self._lease_live():
             self._snapshotter.maybe_write(now)
         return results
+
+    def _lease_live(self) -> bool:
+        """Mid-tick guard: True when no leader is wired or OUR lease still
+        has time left.  Re-checked before each mutating phase so a tick
+        that outlived its lease aborts (counted) instead of acting while
+        deposed; the per-write fence is the backstop underneath."""
+        if self.leader is None:
+            return True
+        try:
+            if self.leader.lease_remaining() > 0.0:
+                return True
+        except Exception:
+            log.warning("mid-tick lease check failed; aborting",
+                        exc_info=True)
+        self._midtick_aborts += 1
+        metrics.leader_midtick_aborts().inc()
+        return False
 
     def _supervised(self, now: float, name: str,
                     reconcile: Callable[[], object],
@@ -330,6 +517,165 @@ class ControllerManager:
             snap["solver"] = health.snapshot()
         return snap
 
+    # ---- readiness lifecycle ------------------------------------------
+    READY_PHASES = ("STARTING", "RESTORING", "PROBING",
+                    "LEADING", "STANDBY", "DRAINING")
+
+    def _set_phase(self, phase: str) -> None:
+        if phase == self.phase:
+            return
+        prev, self.phase = self.phase, phase
+        self.phase_transitions[phase] = \
+            self.phase_transitions.get(phase, 0) + 1
+        if phase == "LEADING" and prev == "STANDBY":
+            self.promotions += 1
+        metrics.ready_state().set(0, {"phase": prev})
+        metrics.ready_state().set(1, {"phase": phase})
+        metrics.ready_transitions().inc({"phase": phase})
+        log.info("readiness: %s -> %s", prev, phase)
+
+    def _enter_role_phase(self) -> None:
+        if self.phase == "DRAINING":
+            return
+        if self.leader is None or self.leader.is_leader():
+            self._set_phase("LEADING")
+        else:
+            self._set_phase("STANDBY")
+
+    def startup(self) -> str:
+        """Walk the readiness ladder before taking traffic: RESTORING
+        (warm restore when gated), PROBING (arena parity probe), then the
+        role phase.  Returns the restore outcome ("none" when WarmRestart
+        is off) so __main__ can log it."""
+        opts = self.operator.options
+        if opts.gate("WarmRestart") and getattr(opts, "snapshot_path", ""):
+            self._set_phase("RESTORING")
+            from ..state.snapshot import restore_snapshot
+            with self._state_lock:
+                self.restore_outcome = restore_snapshot(
+                    opts.snapshot_path, self.operator, manager=self)
+        self._set_phase("PROBING")
+        with self._state_lock:
+            self.probe_outcome = self.parity_probe()
+        self._enter_role_phase()
+        return self.restore_outcome
+
+    def parity_probe(self, sample: int = 16) -> str:
+        """Prove the (possibly restored) arena sane before /readyz flips:
+        `gather()` over a deterministic pod sample must be bit-identical
+        to a cold `tensorize_nodes` on the same nodes.  A mismatch
+        invalidates the arena (so the first real solve rebuilds cold —
+        degraded but correct) instead of letting a silently-wrong slab
+        serve packing decisions."""
+        import numpy as np
+        cluster = self.operator.cluster
+        arena = getattr(cluster, "arena", None)
+        outcome = "skipped"
+        if arena is not None and cluster.nodes:
+            reps = [cluster.pods[uid]
+                    for uid in sorted(cluster.pods)][:sample]
+            warm = arena.gather(reps)
+            if warm is not None:
+                nodes, alloc, used, compat = warm
+                cold = cluster.tensorize_nodes(reps)
+                same = ([n.name for n in nodes] ==
+                        [n.name for n in cold[0]]
+                        and np.array_equal(alloc, cold[1])
+                        and np.array_equal(used, cold[2])
+                        and np.array_equal(compat, cold[3]))
+                outcome = "ok" if same else "mismatch"
+                if not same:
+                    arena.invalidate("parity_probe")
+                    log.error("arena parity probe FAILED: warm gather "
+                              "diverges from cold tensorize; arena "
+                              "invalidated")
+        metrics.ready_probes().inc({"outcome": outcome})
+        return outcome
+
+    def liveness_report(self) -> tuple:
+        """/healthz payload: process-level liveness — supervisor circuits,
+        the solver ladder, watchdog trips, snapshot freshness.  `live`
+        goes False (503) only on a wedge the process cannot dig itself
+        out of: every controller circuit open at once, or the snapshot
+        cadence silently stuck past 3x its interval while we still hold
+        the fence."""
+        now = self.clock()
+        sups = {name: sup.snapshot()
+                for name, sup in sorted(self.supervisors.items())}
+        open_circuits = sorted(n for n, s in sups.items()
+                               if s.get("state") == "open")
+        wedges = []
+        if self.supervisors and \
+                len(open_circuits) == len(self.supervisors):
+            wedges.append("all_circuits_open")
+        snap_age = None
+        sw = self._snapshotter
+        if sw is not None and sw._last_written != float("-inf"):
+            snap_age = max(0.0, now - sw._last_written)
+            if snap_age > 3.0 * sw.interval_s and \
+                    (self.fence is None or self.fence.held()):
+                wedges.append("snapshot_stale")
+        trips = sum(v for _, _, v in metrics.watchdog_trips().samples())
+        report: Dict[str, object] = {
+            "live": not wedges,
+            "wedges": wedges,
+            "phase": self.phase,
+            "circuits_open": open_circuits,
+            "watchdog_trips": int(trips),
+            "snapshot_age_s": round(snap_age, 3)
+            if snap_age is not None else None,
+        }
+        prov = self.controllers.get("provisioning")
+        health = getattr(prov, "health", None) if prov is not None else None
+        if health is not None:
+            report["solver"] = health.snapshot()
+        return report, not wedges
+
+    def readiness_report(self) -> tuple:
+        """/readyz payload: restored + probed + role.  Ready only in
+        LEADING/STANDBY (restore and parity probe behind us, role
+        settled) AND with the cloud breaker closed — the half-open
+        breaker semantics callers of the old combined endpoint relied on
+        (cloud/provider.py liveness_probe)."""
+        cloud = getattr(self.operator, "cloud_provider", None)
+        cloud_ok = cloud is None or bool(cloud.liveness_probe())
+        ready = self.phase in ("LEADING", "STANDBY") and cloud_ok
+        role = "single" if self.leader is None else \
+            ("leader" if self.phase == "LEADING" else "standby")
+        return ({"ready": ready, "phase": self.phase, "role": role,
+                 "restore": self.restore_outcome,
+                 "probe": self.probe_outcome,
+                 "cloud": cloud_ok,
+                 "fence_epoch": self.leader.fence_epoch()
+                 if self.leader is not None else None}, ready)
+
+    def ha_snapshot_state(self) -> Dict:
+        """Leader/readiness state for the WarmRestart snapshot: the
+        counters a promoted successor carries forward, plus the epoch
+        the snapshot was stamped under (forensic — the successor's own
+        acquisition decides the live epoch, never the snapshot)."""
+        return {
+            "phase": self.phase,
+            "epoch": self.leader.fence_epoch()
+            if self.leader is not None else 0,
+            "transitions": dict(self.phase_transitions),
+            "promotions": self.promotions,
+            "skipped_ticks": self._skipped_ticks,
+            "midtick_aborts": self._midtick_aborts,
+            "lease_errors": self._lease_errors,
+        }
+
+    def ha_restore_state(self, data: Dict) -> None:
+        """Restore the HA counters (phase itself is NOT restored: the
+        restoring process is walking its own readiness ladder and must
+        not teleport into the predecessor's phase)."""
+        self.phase_transitions = {str(k): int(v) for k, v in
+                                  dict(data.get("transitions") or {}).items()}
+        self.promotions = int(data.get("promotions", 0))
+        self._skipped_ticks = int(data.get("skipped_ticks", 0))
+        self._midtick_aborts = int(data.get("midtick_aborts", 0))
+        self._lease_errors = int(data.get("lease_errors", 0))
+
     def run(self, tick_seconds: float = 0.25,
             stop_after: Optional[float] = None) -> None:
         """Blocking loop (main.go op.Start analog)."""
@@ -342,11 +688,21 @@ class ControllerManager:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._snapshotter is not None:
-            # SIGTERM hook: one final snapshot so the successor resumes
-            # from the moment of shutdown, not the last cadence tick
-            with self._state_lock:
+        first = self.phase != "DRAINING"
+        self._set_phase("DRAINING")
+        # graceful handover, in order and under the state lock: any
+        # in-flight tick drains first, then ONE final fenced snapshot,
+        # then the lease is released in place — the standby's next
+        # acquire succeeds immediately (<TTL failover, not TTL+drift)
+        with self._state_lock:
+            if self._snapshotter is not None and first:
                 self._snapshotter.write_final()
+            if self.leader is not None and first:
+                try:
+                    self.leader.release()
+                except Exception:
+                    log.warning("lease release failed during drain",
+                                exc_info=True)
         if self._http is not None:
             self._http.shutdown()
         refinery = getattr(self.controllers.get("provisioning"), "refinery",
@@ -601,16 +957,19 @@ class ControllerManager:
                         self.wfile.write(body)
                         return
                     ctype = "application/json"
-                elif self.path in ("/healthz", "/readyz"):
-                    ok = manager.operator.cloud_provider.liveness_probe()
-                    body = (b"ok" if ok else b"unhealthy")
-                    ctype = "text/plain"
-                    if not ok:
-                        self.send_response(503)
-                        self.send_header("Content-Type", ctype)
-                        self.end_headers()
-                        self.wfile.write(body)
-                        return
+                elif url.path == "/healthz":
+                    # liveness: is the PROCESS healthy (circuits, ladder,
+                    # watchdogs, snapshot freshness) — not whether it
+                    # should take traffic; that's /readyz
+                    payload, live = manager.liveness_report()
+                    self._json(payload, 200 if live else 503)
+                    return
+                elif url.path == "/readyz":
+                    # readiness: restored + parity-probed + role settled
+                    # + cloud breaker closed
+                    payload, ready = manager.readiness_report()
+                    self._json(payload, 200 if ready else 503)
+                    return
                 else:
                     self.send_response(404)
                     self.end_headers()

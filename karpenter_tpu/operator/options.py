@@ -91,6 +91,13 @@ class Options:
     # bit-identical; a slab failure falls back to host assembly with a
     # counted outcome under a DecodeHealth breaker (docs/performance.md
     # "decode latency").
+    # HAFailover: fenced leadership + readiness-gated promotion
+    # (utils/fencing.py, docs/robustness.md "HA failover") — the lease
+    # carries a monotone fencing epoch; snapshot writes and cloud
+    # launch/terminate refuse (counted) under a stale fence, and /readyz
+    # flips only after the restore + arena-parity-probe ladder.  Off by
+    # default; enable with --ha-failover or --feature-gates
+    # HAFailover=true (pair with --leader-elect + --lease-path).
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
                                  "LPRefinery": False, "Forecast": False,
@@ -98,7 +105,8 @@ class Options:
                                  "ShardedSolve": False,
                                  "WarmRestart": False,
                                  "IngestBatch": False,
-                                 "DeviceDecode": False})
+                                 "DeviceDecode": False,
+                                 "HAFailover": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -129,6 +137,11 @@ class Options:
     snapshot_path: str = ""                 # snapshot file ("" = disabled)
     snapshot_interval_s: float = 30.0       # cadence between snapshots
     ingest_max_events: int = 100_000        # pending cap → rebuild degrade
+    # HA leadership knobs (used with --leader-elect; HAFailover adds the
+    # fencing/readiness machinery on top)
+    lease_path: str = ""                    # lease file ("" = derive from
+                                            # cluster name in tmpdir)
+    lease_ttl_s: float = 15.0               # leadership lease TTL
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
@@ -282,6 +295,18 @@ class Options:
                        help="pending coalesced events before the batcher "
                             "degrades to a full arena rebuild (never "
                             "drops events)")
+        p.add_argument("--ha-failover", action="store_true", default=False,
+                       help="fence snapshot/cloud writes on the leadership "
+                            "epoch and gate /readyz on the restore+probe "
+                            "ladder (shorthand for --feature-gates "
+                            "HAFailover=true; pair with --leader-elect)")
+        p.add_argument("--lease-path",
+                       default=env.get("lease_path", ""),
+                       help="leadership lease file (empty derives "
+                            "karpenter-<cluster>.lease in the tmpdir)")
+        p.add_argument("--lease-ttl", type=float, dest="lease_ttl_s",
+                       default=env.get("lease_ttl_s", 15.0),
+                       help="leadership lease TTL in seconds")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -320,6 +345,8 @@ class Options:
             snapshot_path=ns.snapshot_path,
             snapshot_interval_s=ns.snapshot_interval_s,
             ingest_max_events=ns.ingest_max_events,
+            lease_path=ns.lease_path,
+            lease_ttl_s=ns.lease_ttl_s,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
@@ -339,6 +366,9 @@ class Options:
             opts.feature_gates["WarmRestart"] = True
         if ns.ingest_batch:
             opts.feature_gates["IngestBatch"] = True
+        if ns.ha_failover:
+            opts.feature_gates["HAFailover"] = True
+            opts.leader_elect = True  # fencing is meaningless without a lease
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts
@@ -377,6 +407,7 @@ class Options:
             "chaos_seed": int,
             "snapshot_interval_s": float,
             "ingest_max_events": int,
+            "lease_ttl_s": float,
         }
         for f in fields(Options):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())

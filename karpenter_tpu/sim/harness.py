@@ -105,7 +105,8 @@ class SimHarness:
                  sharded_solve: Optional[bool] = None,
                  warm_restart: Optional[bool] = None,
                  ingest_batch: Optional[bool] = None,
-                 device_decode: Optional[bool] = None):
+                 device_decode: Optional[bool] = None,
+                 ha_failover: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
         twice — knobs still come from the scenario's forecast block.
@@ -119,7 +120,11 @@ class SimHarness:
         goldens are recorded with both off.  `device_decode` overrides the
         DeviceDecode gate (default off): columnar slab decode with
         bit-identical plans, so gate-ON replays match the same goldens for
-        scenarios whose batches clear the decode floor."""
+        scenarios whose batches clear the decode floor.  `ha_failover`
+        overrides the HAFailover gate (default off): a virtual-clock
+        LeaderElector is wired into the manager so lease expiry, fencing
+        refusals, and `leader.lease` chaos replay deterministically —
+        goldens for non-HA scenarios are recorded with the gate off."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -150,6 +155,12 @@ class SimHarness:
             opts.feature_gates["IngestBatch"] = bool(ingest_batch)
         if device_decode is not None:
             opts.feature_gates["DeviceDecode"] = bool(device_decode)
+        ha = scenario.ha
+        self._ha_enabled = bool(ha_failover) if ha_failover is not None \
+            else (ha is not None and ha.enabled)
+        if self._ha_enabled:
+            opts.feature_gates["HAFailover"] = True
+            opts.leader_elect = True
         fc = scenario.forecast
         fc_on = forecast if forecast is not None \
             else (fc is not None and fc.enabled)
@@ -194,7 +205,23 @@ class SimHarness:
             b.batcher.options.max_timeout = 0.0
 
         controllers = build_controllers(self.op)
-        self.mgr = ControllerManager(self.op, controllers, clock=self.clock)
+        # HAFailover: a real (virtual-clock) elector so the whole fencing
+        # machinery — epoch bumps at lease expiry, mid-tick guards, chaos
+        # at leader.lease — replays deterministically.  The lease lives in
+        # a tempdir owned by the harness; its path never reaches the report.
+        self.leader = None
+        if self._ha_enabled:
+            import os
+            import tempfile
+            from ..operator.manager import LeaderElector
+            self._ha_dir = tempfile.TemporaryDirectory(
+                prefix="karpenter-sim-ha-")
+            self.leader = LeaderElector(
+                os.path.join(self._ha_dir.name, "sim.lease"), "sim-leader",
+                ttl=float(ha.ttl_s) if ha is not None else 15.0,
+                clock=self.clock)
+        self.mgr = ControllerManager(self.op, controllers, clock=self.clock,
+                                     leader=self.leader)
         for entry in self.mgr._entries:
             entry.interval = scenario.intervals.get(entry.name,
                                                     entry.interval)

@@ -160,6 +160,28 @@ def build_report(harness) -> Dict:
             chaos_sec["solver_transitions"] = dict(
                 sorted(health.transitions.items()))
         report["chaos"] = chaos_sec
+    if getattr(harness, "_ha_enabled", False):
+        # present ONLY when the HAFailover gate ran — same conditional
+        # contract as forecast/chaos, so every HA-off report (all
+        # pre-existing goldens) stays byte-identical.  Everything is
+        # deterministic: lease transitions follow the virtual clock and
+        # the chaos schedule, fencing refusals the seeded injections.
+        leader = harness.leader
+        mgr = harness.mgr
+        fence = getattr(mgr, "fence", None)
+        report["ha"] = {
+            "acquisitions": leader.acquisitions,
+            "losses": leader.losses,
+            "releases": leader.releases,
+            "fence_epoch": leader.fence_epoch(),
+            "lease_errors": mgr._lease_errors,
+            "skipped_ticks": mgr._skipped_ticks,
+            "midtick_aborts": mgr._midtick_aborts,
+            "promotions": mgr.promotions,
+            "phase_at_end": mgr.phase,
+            "fence_refusals": dict(sorted(fence.refusals.items()))
+            if fence is not None else {},
+        }
     return report
 
 

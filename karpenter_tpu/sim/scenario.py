@@ -265,6 +265,21 @@ class ChaosSpec:
 
 
 @dataclass
+class HASpec:
+    """HA failover configuration for a scenario (docs/robustness.md "HA
+    failover").  `enabled: true` turns the HAFailover gate on for the
+    simulated operator: a virtual-clock `LeaderElector` is wired in (so
+    lease expiry, chaos at `leader.lease`, and fencing refusals all play
+    out deterministically) and the report grows an "ha" section."""
+    enabled: bool = True
+    ttl_s: float = 15.0
+
+    def validate(self) -> None:
+        if self.ttl_s <= 0:
+            raise ScenarioError("ha: ttl_s must be positive")
+
+
+@dataclass
 class Scenario:
     name: str
     duration_s: float = 86_400.0
@@ -286,6 +301,8 @@ class Scenario:
     forecast: Optional[ForecastSpec] = None
     # deterministic fault injection (None = injector stays disarmed)
     chaos: Optional[ChaosSpec] = None
+    # fenced leadership drill (None = HAFailover gate stays off)
+    ha: Optional[HASpec] = None
 
     def validate(self) -> None:
         if not self.name:
@@ -309,6 +326,8 @@ class Scenario:
             self.forecast.validate()
         if self.chaos is not None:
             self.chaos.validate()
+        if self.ha is not None:
+            self.ha.validate()
         names = [w.name for w in self.workload]
         if len(set(names)) != len(names):
             raise ScenarioError(f"duplicate wave names: {names}")
@@ -349,6 +368,9 @@ _CHAOS_RULE_FIELDS = {
     "point": str, "key": str, "action": str, "rate": float, "at_s": float,
     "until_s": float, "latency_s": float, "count": int, "error_code": str,
 }
+_HA_FIELDS = {
+    "enabled": bool, "ttl_s": float,
+}
 
 
 def _coerce(ctx: str, doc: Dict, schema: Dict) -> Dict:
@@ -377,7 +399,7 @@ def scenario_from_dict(doc: Dict) -> Scenario:
         raise ScenarioError(f"scenario document must be a mapping, "
                             f"got {type(doc).__name__}")
     known = {"name", "zones", "intervals", "workload", "faults",
-             "forecast", "chaos", *_SCENARIO_SCALARS}
+             "forecast", "chaos", "ha", *_SCENARIO_SCALARS}
     for key in doc:
         if key not in known:
             raise ScenarioError(f"unknown scenario field {key!r} "
@@ -448,6 +470,14 @@ def scenario_from_dict(doc: Dict) -> Scenario:
             enabled=bool(cdoc.get("enabled", True)),
             seed=None if cdoc.get("seed") is None else int(cdoc["seed"]),
             rules=rules)
+    if doc.get("ha") is not None:
+        hdoc = doc["ha"]
+        if not isinstance(hdoc, dict):
+            raise ScenarioError("ha must be a mapping")
+        for key in hdoc:
+            if key not in _HA_FIELDS:
+                raise ScenarioError(f"ha: unknown field {key!r}")
+        kw["ha"] = HASpec(**_coerce("ha", hdoc, _HA_FIELDS))
     sc = Scenario(**kw)
     sc.validate()
     return sc

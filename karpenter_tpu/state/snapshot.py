@@ -136,6 +136,12 @@ def collect_sections(op, manager=None) -> Dict:
         dh = _decode_health_of(manager)
         if dh is not None:
             sections["decode"] = dh.snapshot_state()
+        # HA leader/readiness state (operator/manager.py): present only
+        # for a manager that grew the lifecycle (hasattr guards older
+        # pickles and stub managers in tests)
+        ha = getattr(manager, "ha_snapshot_state", None)
+        if ha is not None:
+            sections["leader"] = ha()
     sections["meta"] = {
         "version": VERSION,
         "written_at": op.clock(),
@@ -149,12 +155,22 @@ def collect_sections(op, manager=None) -> Dict:
 # file format: MAGIC ⊕ sha256(payload) ⊕ payload (one pickle)
 # ---------------------------------------------------------------------------
 
-def write_snapshot(path: str, op, manager=None) -> bool:
+def write_snapshot(path: str, op, manager=None, fence=None) -> bool:
     """Serialize + atomically replace `path`.  Returns success; a failed
-    write leaves the previous snapshot intact (tmp + rename)."""
+    write leaves the previous snapshot intact (tmp + rename).  With a
+    `fence` (utils/fencing.LeaseFence), the write is REFUSED when the
+    fencing epoch is stale — the "two operators, one snapshot file"
+    invariant: a deposed leader's late write must lose to the successor,
+    and the refusal is counted, never silent."""
     t0 = time.perf_counter()
+    if fence is not None and not fence.check("snapshot"):
+        metrics.snapshot_writes().inc({"outcome": "stale_fence"})
+        return False
     try:
-        payload = pickle.dumps(collect_sections(op, manager),
+        sections = collect_sections(op, manager)
+        if fence is not None:
+            sections["meta"]["fence_epoch"] = fence.epoch()
+        payload = pickle.dumps(sections,
                                protocol=pickle.HIGHEST_PROTOCOL)
         blob = MAGIC + hashlib.sha256(payload).digest() + payload
         tmp = f"{path}.tmp"
@@ -291,6 +307,9 @@ def _apply_sections(sections: Dict, op, manager=None) -> None:
         dh = _decode_health_of(manager)
         if dh is not None and "decode" in sections:
             dh.restore_state(sections["decode"])
+        ha = getattr(manager, "ha_restore_state", None)
+        if ha is not None and sections.get("leader") is not None:
+            ha(sections["leader"])
 
 
 # ---------------------------------------------------------------------------
@@ -302,17 +321,23 @@ class SnapshotWriter:
     `write_final()` from `stop()` (the SIGTERM hook)."""
 
     def __init__(self, path: str, op, manager=None,
-                 interval_s: float = 30.0):
+                 interval_s: float = 30.0, fence=None):
         self.path = path
         self.op = op
         self.manager = manager
         self.interval_s = float(interval_s)
         self._last_written = float("-inf")
+        # HAFailover: the manager attaches its LeaseFence here, so every
+        # cadence AND final write validates the fencing epoch first —
+        # a deposed replica's cadence can never clobber the successor's
+        # snapshot (the concrete split-brain bug of the unfenced writer)
+        self.fence = fence
 
     def maybe_write(self, now: float) -> bool:
         if not self.path or now - self._last_written < self.interval_s:
             return False
-        ok = write_snapshot(self.path, self.op, self.manager)
+        ok = write_snapshot(self.path, self.op, self.manager,
+                            fence=self.fence)
         if ok:
             self._last_written = now
         return ok
@@ -320,4 +345,5 @@ class SnapshotWriter:
     def write_final(self) -> bool:
         if not self.path:
             return False
-        return write_snapshot(self.path, self.op, self.manager)
+        return write_snapshot(self.path, self.op, self.manager,
+                              fence=self.fence)

@@ -50,6 +50,7 @@ POINTS = frozenset({
     "solver.sweep",           # batched consolidation sweep
     "cloud.api",              # FakeCloud API entry, key = api name
     "refinery.refine",        # background guide refinement
+    "leader.lease",           # lease I/O (acquire/release), key = op
 })
 
 ACTIONS = ("error", "latency", "hang")

@@ -1,0 +1,77 @@
+"""Fencing tokens for the HA control plane (the HAFailover gate).
+
+Leader election alone cannot make "exactly one writer" an invariant: a
+deposed leader that is mid-tick when its lease expires still has live
+references to the snapshot file and the cloud substrate, and a wall of
+GC pauses or a slow solve can stretch that window arbitrarily.  The
+classic fix (Chubby/ZooKeeper fencing tokens) is what `LeaseFence`
+implements over the file lease: every acquisition by a NEW holder bumps
+a monotone `epoch` stored in the lease itself, every guarded write
+re-validates that the lease still names this process at the epoch it
+acquired, and a stale check REFUSES the write with a counter —
+`karpenter_leader_fence_refusals_total{op}` proves refusal, not absence
+of attempts.
+
+The guarded funnels (graftlint RS004 keeps them closed):
+
+  * `state/snapshot.py` — `SnapshotWriter` cadence/final writes and the
+    `write_snapshot` seam itself ("two operators, one snapshot file");
+  * `cloud/provider.py` — the `_create` launch funnel and the `_delete`
+    terminate funnel raise `StaleFenceError` instead of mutating.
+
+`fence=None` everywhere means "no HA": single-replica deployments, the
+sim, and every pre-HA test run unfenced and byte-identically.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+from . import metrics
+
+log = logging.getLogger("karpenter_tpu.fencing")
+
+
+class StaleFenceError(RuntimeError):
+    """A guarded mutation was attempted with a stale fencing epoch: the
+    lease names another holder (or a newer epoch of this one).  The
+    caller must treat this as a hard refusal, never retry-until-success
+    — the new leader owns the resource now."""
+
+
+class LeaseFence:
+    """Holder-side fencing token over a `LeaderElector` lease.
+
+    `check(op)` is the one seam every guarded write calls: True means
+    the lease still names our elector at the epoch it acquired; False
+    means the write must not happen, and the refusal has already been
+    counted (metrics + the `refusals` dict the failover drill asserts
+    on)."""
+
+    def __init__(self, elector):
+        self.elector = elector
+        self.refusals: Dict[str, int] = {}
+
+    def epoch(self) -> int:
+        """The fencing epoch this process last acquired with (0 = never)."""
+        return self.elector.fence_epoch()
+
+    def held(self) -> bool:
+        return self.elector.holds_fence()
+
+    def check(self, op: str) -> bool:
+        """Validate the fence for one guarded mutation.  Counted refusal
+        on staleness; exceptions reading the lease count as stale (an
+        unreadable lease cannot prove we still hold it)."""
+        try:
+            if self.held():
+                return True
+        except Exception:
+            log.exception("fence check for %s could not read the lease; "
+                          "refusing", op)
+        self.refusals[op] = self.refusals.get(op, 0) + 1
+        metrics.leader_fence_refusals().inc({"op": op})
+        log.warning("stale fence: refused %s (epoch %d no longer holds "
+                    "the lease)", op, self.elector.fence_epoch())
+        return False

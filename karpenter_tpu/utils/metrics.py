@@ -1223,3 +1223,84 @@ def ingest_overflows() -> Counter:
     return REGISTRY.counter(
         "karpenter_ingest_overflows_total",
         "Ingestion-batcher overflow degradations to full rebuild.")
+
+
+# --- HA: fenced leadership + readiness lifecycle ---------------------------
+
+def leader_transitions() -> Counter:
+    """Leadership lifecycle events on this replica: `acquired` (won the
+    lease with a bumped fencing epoch), `lost` (another holder's
+    unexpired lease, or a lease-read failure deposed us), `released`
+    (graceful SIGTERM handover expired our own lease)."""
+    return REGISTRY.counter(
+        "karpenter_leader_transitions_total",
+        "Leader-election transitions on this replica, by event.",
+        labels=("event",))
+
+
+def leader_fence_epoch() -> Gauge:
+    """The monotone fencing epoch this replica last acquired the lease
+    with (0 = never led).  Strictly increases across failovers; every
+    guarded snapshot/cloud write validates against it."""
+    return REGISTRY.gauge(
+        "karpenter_leader_fence_epoch",
+        "Fencing epoch of this replica's last lease acquisition.")
+
+
+def leader_fence_refusals() -> Counter:
+    """Guarded mutations refused because the fencing epoch was stale, by
+    operation (`snapshot` | `launch` | `terminate`).  Nonzero here is the
+    split-brain invariant WORKING: a deposed writer attempted the
+    mutation and was stopped."""
+    return REGISTRY.counter(
+        "karpenter_leader_fence_refusals_total",
+        "Stale-fence refusals of guarded mutations, by operation.",
+        labels=("op",))
+
+
+def leader_lease_errors() -> Counter:
+    """Lease I/O failures during acquire/renew (including injected
+    `leader.lease` chaos).  Each one deposes the replica for that tick —
+    an unreadable lease cannot prove leadership."""
+    return REGISTRY.counter(
+        "karpenter_leader_lease_errors_total",
+        "Lease read/write failures treated as loss of leadership.")
+
+
+def leader_midtick_aborts() -> Counter:
+    """Ticks aborted before their mutating phase because the lease had
+    less than zero remaining mid-tick — the guard that keeps a long tick
+    from outliving its lease into a launch or snapshot."""
+    return REGISTRY.counter(
+        "karpenter_leader_midtick_aborts_total",
+        "Ticks aborted mid-flight on an expired lease.")
+
+
+def ready_state() -> Gauge:
+    """Readiness state machine (operator/manager.py): 1 for the current
+    phase, 0 for the rest.  Phases: STARTING, RESTORING, PROBING,
+    LEADING, STANDBY, DRAINING."""
+    return REGISTRY.gauge(
+        "karpenter_ready_state",
+        "Readiness lifecycle phase (1 = current), by phase.",
+        labels=("phase",))
+
+
+def ready_transitions() -> Counter:
+    """Entries into each readiness phase; `LEADING` entries from
+    `STANDBY` are promotions (a failover completing)."""
+    return REGISTRY.counter(
+        "karpenter_ready_transitions_total",
+        "Readiness-phase entries, by target phase.",
+        labels=("phase",))
+
+
+def ready_probes() -> Counter:
+    """Arena parity probes run during PROBING, by outcome: `ok` (restored
+    gather is bit-identical to a cold tensorize on the sample),
+    `mismatch` (arena invalidated, cold rebuild before serving), or
+    `skipped` (no arena / nothing restored to prove)."""
+    return REGISTRY.counter(
+        "karpenter_ready_probes_total",
+        "Readiness arena parity probes, by outcome.",
+        labels=("outcome",))

@@ -1,0 +1,785 @@
+"""HA failover suite (fenced leadership + readiness-gated promotion).
+
+The tentpole contract: leadership is proven by a fencing epoch stored in
+the lease itself, every guarded write (snapshot file, cloud launch /
+terminate) re-validates that epoch, and a stale check REFUSES with a
+counter — refusal is *proven*, never inferred from absence.  Readiness
+is a ladder (STARTING → RESTORING → PROBING → {LEADING, STANDBY} →
+DRAINING) gated by the arena parity probe, surfaced as real /healthz and
+/readyz semantics.  The acceptance test at the bottom is the two-process
+kill -9 drill: a SIGKILL'd leader, a standby that promotes through warm
+restore + parity probe, a plan stream byte-identical to an uninterrupted
+run, and a ghost incarnation of the dead leader whose every write is
+refused on the counters."""
+
+import fcntl
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import (ImageInfo, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.operator import (ControllerManager, Operator, Options,
+                                    build_controllers)
+from karpenter_tpu.operator.manager import LeaderElector
+from karpenter_tpu.state.snapshot import (load_sections, restore_snapshot,
+                                          write_snapshot)
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.chaos import CHAOS, ChaosError, ChaosRule
+from karpenter_tpu.utils.fencing import LeaseFence, StaleFenceError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    CHAOS.reset()
+
+
+def seed_cloud(op):
+    op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {}),
+                        SubnetInfo("s-b", "zone-b", 10_000, {})]
+    op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+    op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+    op.params.parameters = {
+        "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+    return op
+
+
+def pod(name=None, cpu=500):
+    return Pod(name=name,
+               requests=ResourceList({CPU: cpu, MEMORY: 512 * 2**20}))
+
+
+def elector(tmp_path, ident, clk, ttl=15.0):
+    return LeaderElector(str(tmp_path / "ha.lease"), ident, ttl=ttl,
+                         clock=lambda: clk[0])
+
+
+def ha_stack(tmp_path, clk, ttl=15.0, snap="", ident="self", gates=()):
+    """Operator + manager with an HAFailover-gated elector wired in."""
+    clock = lambda: clk[0]
+    opts = Options(snapshot_path=snap, interruption_queue="q")
+    opts.feature_gates["HAFailover"] = True
+    if snap:
+        opts.feature_gates["WarmRestart"] = True
+    for g in gates:
+        opts.feature_gates[g] = True
+    op = seed_cloud(Operator(opts, catalog=generate_catalog(10),
+                             clock=clock))
+    led = elector(tmp_path, ident, clk, ttl=ttl)
+    mgr = ControllerManager(op, build_controllers(op), clock=clock,
+                            leader=led)
+    return op, mgr, led
+
+
+def provision(op, mgr, clk, n=6):
+    op.cluster.add_pods([pod() for _ in range(n)])
+    mgr.tick()
+    clk[0] += 1.1
+    mgr.tick()
+    assert op.cluster.nodes and not op.cluster.pending_pods()
+
+
+def metric_total(family, **labels):
+    want = tuple(sorted(labels.items()))
+    return sum(v for _, kv, v in family.samples()
+               if tuple(sorted(kv)) == want or not labels)
+
+
+# ---------------------------------------------------------------------------
+# elector edge cases: the lease is hostile territory
+# ---------------------------------------------------------------------------
+
+class TestLeaderElector:
+    def test_first_acquisition_is_epoch_one(self, tmp_path):
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk)
+        assert a.try_acquire()
+        assert a.is_leader() and a.holds_fence()
+        assert a.fence_epoch() == 1
+        assert a.acquisitions == 1 and a.losses == 0
+
+    def test_renewal_preserves_epoch_and_counts_once(self, tmp_path):
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk, ttl=10.0)
+        assert a.try_acquire()
+        for _ in range(5):
+            clk[0] += 3.0            # always inside the TTL: one long term
+            assert a.try_acquire()
+        assert a.fence_epoch() == 1
+        assert a.acquisitions == 1
+
+    def test_rival_valid_lease_is_rejected(self, tmp_path):
+        clk = [100.0]
+        a, b = elector(tmp_path, "a", clk), elector(tmp_path, "b", clk)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert not b.is_leader() and not b.holds_fence()
+        assert b.fence_epoch() == 0 and b.acquisitions == 0
+        assert a.holds_fence()
+
+    def test_takeover_after_expiry_bumps_epoch(self, tmp_path):
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk, ttl=5.0)
+        b = elector(tmp_path, "b", clk, ttl=5.0)
+        assert a.try_acquire()
+        clk[0] += 6.0                # a's lease expires
+        assert b.try_acquire()
+        assert b.fence_epoch() == 2
+        # the strict fence: a's epoch-1 token is dead forever, even
+        # though the lease file itself no longer mentions a at all
+        assert not a.holds_fence()
+        assert a.lease_remaining() == 0.0
+
+    def test_lease_corruption_mid_read(self, tmp_path):
+        """A lease that cannot be parsed proves nobody's leadership, and
+        re-acquiring over it is a NEW term — the epoch never regresses."""
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk)
+        assert a.try_acquire()
+        before = a.fence_epoch()
+        with open(a.lease_path, "w") as f:
+            f.write("{truncated garbage\x00")
+        assert not a.is_leader()
+        assert not a.holds_fence()
+        assert a.lease_remaining() == 0.0
+        assert a.try_acquire()       # rewrites over the corruption
+        assert a.fence_epoch() > before
+        assert a.holds_fence()
+
+    def test_clock_skew_between_replicas(self, tmp_path):
+        """b's clock runs TTL+1s ahead: from its vantage a's fresh lease
+        is already expired, so b steals it — and a, whose own clock says
+        the term should still be live, must still read itself deposed
+        (the lease names b now; wall clocks never adjudicate)."""
+        clk_a, clk_b = [100.0], [100.0 + 6.0]
+        a = LeaderElector(str(tmp_path / "ha.lease"), "a", ttl=5.0,
+                          clock=lambda: clk_a[0])
+        b = LeaderElector(str(tmp_path / "ha.lease"), "b", ttl=5.0,
+                          clock=lambda: clk_b[0])
+        assert a.try_acquire()
+        assert b.try_acquire()       # expired from b's skewed vantage
+        assert b.fence_epoch() == 2
+        assert not a.holds_fence()
+        # b's lease is stamped in a's future — still "valid" from a's
+        # vantage, so a cannot steal it back
+        assert not a.try_acquire()
+        assert a.losses == 1
+
+    def test_flock_contention_and_holder_crash(self, tmp_path):
+        """While another process holds the kernel flock, try_acquire
+        neither blocks nor mutates; the moment the holder's fd closes
+        (crash included — the kernel releases on close), election
+        proceeds.  This is why the lock is a flock and not a lock
+        *file*: a crashed holder leaves nothing to clean up."""
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk)
+        lock = f"{a.lease_path}.lock"
+        fd = os.open(lock, os.O_CREAT | os.O_WRONLY, 0o644)
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        assert not a.try_acquire()   # lock busy → current verdict (not us)
+        assert not os.path.exists(a.lease_path)
+        os.close(fd)                 # the "crash": no explicit unlock
+        assert a.try_acquire()
+        assert a.fence_epoch() == 1
+
+    def test_release_enables_immediate_takeover(self, tmp_path):
+        """Graceful handover: release + rival acquire + re-acquire, all
+        at the SAME virtual instant — failover costs one election round,
+        not a TTL wait, and the epochs stay strictly monotone."""
+        clk = [100.0]
+        a, b = elector(tmp_path, "a", clk), elector(tmp_path, "b", clk)
+        assert a.try_acquire()
+        assert a.release()
+        assert a.releases == 1
+        assert b.try_acquire()       # no clock advance needed
+        assert b.fence_epoch() == 2
+        assert b.release()
+        assert a.try_acquire()
+        assert a.fence_epoch() == 3
+
+    def test_release_by_non_holder_is_refused(self, tmp_path):
+        clk = [100.0]
+        a, b = elector(tmp_path, "a", clk), elector(tmp_path, "b", clk)
+        assert a.try_acquire()
+        assert not b.release()
+        assert b.releases == 0
+        assert a.holds_fence()       # a's term untouched
+
+    def test_double_release_counts_once(self, tmp_path):
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk)
+        assert a.try_acquire()
+        a.release()
+        a.release()
+        assert a.releases == 1
+
+
+# ---------------------------------------------------------------------------
+# the fence: stale epochs refuse, with counters
+# ---------------------------------------------------------------------------
+
+class TestLeaseFence:
+    def test_check_passes_while_held(self, tmp_path):
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk)
+        assert a.try_acquire()
+        fence = LeaseFence(a)
+        assert fence.check("snapshot")
+        assert fence.refusals == {}
+
+    def test_stale_check_refuses_and_counts_per_op(self, tmp_path):
+        clk = [100.0]
+        a = elector(tmp_path, "a", clk, ttl=5.0)
+        b = elector(tmp_path, "b", clk, ttl=5.0)
+        assert a.try_acquire()
+        fence = LeaseFence(a)
+        clk[0] += 6.0
+        assert b.try_acquire()       # a's token is now stale
+        before = metric_total(metrics.leader_fence_refusals(), op="launch")
+        assert not fence.check("launch")
+        assert not fence.check("launch")
+        assert not fence.check("terminate")
+        assert fence.refusals == {"launch": 2, "terminate": 1}
+        after = metric_total(metrics.leader_fence_refusals(), op="launch")
+        assert after - before == 2
+
+    def test_never_led_is_stale(self, tmp_path):
+        clk = [100.0]
+        fence = LeaseFence(elector(tmp_path, "a", clk))
+        assert not fence.check("snapshot")
+        assert fence.refusals == {"snapshot": 1}
+
+
+class TestFencedWrites:
+    def test_gate_wires_fence_into_both_funnels(self, tmp_path):
+        clk = [100.0]
+        op, mgr, led = ha_stack(tmp_path, clk, snap=str(tmp_path / "s.bin"))
+        assert mgr.fence is not None
+        assert op.cloud_provider.fence is mgr.fence
+        assert mgr._snapshotter.fence is mgr.fence
+
+    def test_gate_off_means_unfenced(self, tmp_path):
+        clk = [100.0]
+        clock = lambda: clk[0]
+        op = seed_cloud(Operator(Options(interruption_queue="q"),
+                                 catalog=generate_catalog(10), clock=clock))
+        mgr = ControllerManager(op, build_controllers(op), clock=clock,
+                                leader=elector(tmp_path, "a", clk))
+        assert mgr.fence is None
+        assert op.cloud_provider.fence is None
+
+    def test_snapshot_write_stamped_while_held_refused_when_stale(
+            self, tmp_path):
+        clk = [100.0]
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=5.0)
+        provision(op, mgr, clk)
+        path = str(tmp_path / "held.bin")
+        assert write_snapshot(path, op, mgr, fence=mgr.fence)
+        sections, reason = load_sections(path)
+        assert reason == "ok"
+        assert sections["meta"]["fence_epoch"] == led.fence_epoch()
+
+        rival = elector(tmp_path, "rival", clk, ttl=5.0)
+        clk[0] += 6.0
+        assert rival.try_acquire()
+        stale_before = metric_total(metrics.snapshot_writes(),
+                                    outcome="stale_fence")
+        path2 = str(tmp_path / "stale.bin")
+        assert write_snapshot(path2, op, mgr, fence=mgr.fence) is False
+        assert not os.path.exists(path2)
+        assert mgr.fence.refusals.get("snapshot") == 1
+        assert metric_total(metrics.snapshot_writes(),
+                            outcome="stale_fence") - stale_before == 1
+
+    def test_snapshotter_cadence_refuses_when_stale(self, tmp_path):
+        clk = [100.0]
+        snap = str(tmp_path / "s.bin")
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=5.0, snap=snap)
+        assert led.try_acquire()
+        rival = elector(tmp_path, "rival", clk, ttl=5.0)
+        clk[0] += 6.0
+        assert rival.try_acquire()
+        assert mgr._snapshotter.maybe_write(clk[0]) is False
+        assert not os.path.exists(snap)
+        assert mgr.fence.refusals.get("snapshot") == 1
+
+    def test_cloud_mutations_raise_stale_fence(self, tmp_path):
+        """The launch and terminate funnels must REFUSE (raise), never
+        quietly mutate: a deposed leader's launch is a ghost node the
+        successor would have to garbage-collect, its terminate kills a
+        node the successor is actively using."""
+        clk = [100.0]
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=5.0)
+        provision(op, mgr, clk)
+        claims = [c for c in op.cloud_provider.list() if c.provider_id]
+        assert claims
+        running_before = len(op.cloud.running())
+
+        rival = elector(tmp_path, "rival", clk, ttl=5.0)
+        clk[0] += 6.0
+        assert rival.try_acquire()
+        with pytest.raises(StaleFenceError):
+            op.cloud_provider.delete(claims[0])
+        with pytest.raises(StaleFenceError):
+            op.cloud_provider.create(claims[0])
+        assert len(op.cloud.running()) == running_before
+        assert mgr.fence.refusals == {"terminate": 1, "launch": 1}
+
+
+# ---------------------------------------------------------------------------
+# the mid-tick guard: a tick that outlives its lease aborts, counted
+# ---------------------------------------------------------------------------
+
+class TestMidTickGuard:
+    def test_slow_tick_aborts_before_snapshot(self, tmp_path):
+        """A controller sweep that eats the whole TTL must not reach the
+        snapshot write: the re-check before the final mutating phase
+        aborts (counted) instead of acting deposed."""
+        clk = [100.0]
+        snap = str(tmp_path / "s.bin")
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=5.0, snap=snap)
+
+        def slow_reconcile():
+            clk[0] += 11.0           # the lease dies mid-tick
+
+        for e in mgr._entries:
+            if e.name == "pricing":
+                e.reconcile = slow_reconcile
+        before = metric_total(metrics.leader_midtick_aborts())
+        mgr.tick()
+        assert mgr._midtick_aborts == 1
+        assert metric_total(metrics.leader_midtick_aborts()) - before == 1
+        assert not os.path.exists(snap)
+
+    def test_fast_tick_writes_normally(self, tmp_path):
+        clk = [100.0]
+        snap = str(tmp_path / "s.bin")
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=15.0, snap=snap)
+        mgr.tick()
+        assert mgr._midtick_aborts == 0
+        assert os.path.exists(snap)
+
+
+# ---------------------------------------------------------------------------
+# readiness ladder: restore → probe → role, and what /readyz + /healthz say
+# ---------------------------------------------------------------------------
+
+class TestReadinessLadder:
+    def test_single_replica_ladder(self):
+        clk = [100.0]
+        clock = lambda: clk[0]
+        op = seed_cloud(Operator(Options(interruption_queue="q"),
+                                 catalog=generate_catalog(10), clock=clock))
+        mgr = ControllerManager(op, build_controllers(op), clock=clock)
+        assert mgr.phase == "STARTING"
+        payload, ready = mgr.readiness_report()
+        assert not ready and payload["phase"] == "STARTING"
+        mgr.startup()
+        assert mgr.phase == "LEADING"
+        assert mgr.probe_outcome == "skipped"   # empty cluster: no slab
+        payload, ready = mgr.readiness_report()
+        assert ready and payload["role"] == "single"
+        assert mgr.phase_transitions.get("PROBING") == 1
+        assert mgr.phase_transitions.get("LEADING") == 1
+
+    def test_startup_restores_and_probes_ok(self, tmp_path):
+        clk = [1000.0]
+        snap = str(tmp_path / "s.bin")
+        op, mgr, led = ha_stack(tmp_path, clk, snap=snap)
+        provision(op, mgr, clk)
+        assert write_snapshot(snap, op, mgr)
+
+        op2, mgr2, led2 = ha_stack(tmp_path / "b", clk, snap=snap,
+                                   ident="b")
+        (tmp_path / "b").mkdir(exist_ok=True)
+        assert mgr2.startup() == "restored"
+        assert mgr2.probe_outcome == "ok"
+        assert set(op2.cluster.nodes) == set(op.cluster.nodes)
+        payload, ready = mgr2.readiness_report()
+        assert payload["restore"] == "restored"
+        assert payload["probe"] == "ok"
+
+    def test_parity_mismatch_invalidates_arena(self, tmp_path):
+        """A restored slab that disagrees with a cold tensorize is the
+        one thing /readyz must never wave through silently: the probe
+        reports mismatch and invalidates, so the first real solve
+        rebuilds cold — degraded but correct."""
+        clk = [1000.0]
+        snap = str(tmp_path / "s.bin")
+        op, mgr, led = ha_stack(tmp_path, clk, snap=snap)
+        provision(op, mgr, clk)
+        assert write_snapshot(snap, op, mgr)
+        op2, mgr2, _ = ha_stack(tmp_path, clk, snap=snap, ident="b")
+        assert restore_snapshot(snap, op2, mgr2) == "restored"
+
+        import karpenter_tpu.state.cluster as cmod
+        invalidations = []
+        arena = op2.cluster.arena
+        orig_inv = arena.invalidate
+        arena.invalidate = lambda reason="": (invalidations.append(reason),
+                                              orig_inv(reason))[1]
+        orig = cmod.Cluster.tensorize_nodes
+
+        def skewed(self, reps):
+            nodes, alloc, used, compat = orig(self, reps)
+            alloc = alloc.copy()
+            alloc[0, 0] += 1.0       # one flipped cell is enough
+            return nodes, alloc, used, compat
+
+        cmod.Cluster.tensorize_nodes = skewed
+        try:
+            outcome = mgr2.parity_probe()
+        finally:
+            cmod.Cluster.tensorize_nodes = orig
+            arena.invalidate = orig_inv
+        assert outcome == "mismatch"
+        assert "parity_probe" in invalidations
+
+    def test_standby_then_promotion(self, tmp_path):
+        clk = [100.0]
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=5.0)
+        rival = elector(tmp_path, "rival", clk, ttl=5.0)
+        assert rival.try_acquire()
+        op.cluster.add_pods([pod()])
+        assert mgr.tick() == {}      # cannot lead: tick skipped whole
+        assert mgr.phase == "STANDBY"
+        assert mgr._skipped_ticks == 1
+        assert op.cluster.pending_pods()
+        payload, ready = mgr.readiness_report()
+        assert ready and payload["role"] == "standby"
+
+        clk[0] += 6.0                # rival dies (never renews)
+        mgr.tick()
+        assert mgr.phase == "LEADING"
+        assert mgr.promotions == 1
+        assert led.fence_epoch() == rival.fence_epoch() + 1
+
+    def test_lease_chaos_skips_ticks_and_counts(self, tmp_path):
+        """The leader.lease chaos point: while lease I/O errors, ticks
+        are skipped whole (leadership unprovable) and counted; when the
+        blackout lifts the next tick re-acquires and proceeds."""
+        clk = [100.0]
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=5.0)
+        CHAOS.configure([ChaosRule(point="leader.lease", key="acquire",
+                                   action="error", rate=1.0)],
+                        seed=0, clock=lambda: clk[0])
+        op.cluster.add_pods([pod()])
+        for _ in range(3):
+            clk[0] += 1.0
+            assert mgr.tick() == {}
+        assert mgr._lease_errors == 3
+        assert op.cluster.pending_pods()
+        CHAOS.reset()
+        clk[0] += 1.1
+        mgr.tick()
+        clk[0] += 1.1
+        mgr.tick()
+        assert mgr.phase == "LEADING"
+        assert not op.cluster.pending_pods()
+
+    def test_ha_counters_roundtrip_through_snapshot(self, tmp_path):
+        """Chaos × restart (satellite): the leader/readiness counters
+        survive the snapshot, the PHASE does not — a restoring process
+        walks its own ladder instead of teleporting into its
+        predecessor's."""
+        clk = [100.0]
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=5.0)
+        CHAOS.configure([ChaosRule(point="leader.lease", key="acquire",
+                                   action="error", rate=1.0)],
+                        seed=0, clock=lambda: clk[0])
+        for _ in range(2):
+            clk[0] += 1.0
+            mgr.tick()
+        CHAOS.reset()
+        clk[0] += 1.0
+        mgr.tick()                   # recovers: STANDBY → LEADING
+        path = str(tmp_path / "ha.bin")
+        assert write_snapshot(path, op, mgr)
+        sections, reason = load_sections(path)
+        assert reason == "ok"
+        assert sections["leader"]["lease_errors"] == 2
+        assert sections["leader"]["epoch"] == led.fence_epoch()
+
+        op2, mgr2, _ = ha_stack(tmp_path, clk, snap=path, ident="b")
+        assert restore_snapshot(path, op2, mgr2) == "restored"
+        assert mgr2._lease_errors == 2
+        assert mgr2._skipped_ticks == mgr._skipped_ticks
+        assert mgr2.promotions == mgr.promotions
+        assert mgr2.phase == "STARTING"   # NOT the predecessor's phase
+
+
+class TestEndpoints:
+    def _get(self, port, path):
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5)
+            return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_readyz_flips_with_the_ladder(self):
+        clk = [100.0]
+        clock = lambda: clk[0]
+        op = seed_cloud(Operator(Options(interruption_queue="q"),
+                                 catalog=generate_catalog(10), clock=clock))
+        mgr = ControllerManager(op, build_controllers(op), clock=clock)
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            code, body = self._get(port, "/readyz")
+            assert code == 503
+            assert body["ready"] is False and body["phase"] == "STARTING"
+            code, body = self._get(port, "/healthz")
+            assert code == 200 and body["live"] is True   # alive ≠ ready
+            mgr.startup()
+            code, body = self._get(port, "/readyz")
+            assert code == 200
+            assert body["role"] == "single" and body["probe"] == "skipped"
+        finally:
+            mgr.stop()
+
+    def test_healthz_wedge_is_503(self):
+        clk = [100.0]
+        clock = lambda: clk[0]
+        op = seed_cloud(Operator(Options(interruption_queue="q"),
+                                 catalog=generate_catalog(10), clock=clock))
+        mgr = ControllerManager(op, build_controllers(op), clock=clock)
+
+        class OpenSup:
+            def snapshot(self):
+                return {"state": "open"}
+
+        mgr.supervisors = {"a": OpenSup(), "b": OpenSup()}
+        port = mgr.serve_endpoints(metrics_port=0)
+        try:
+            code, body = self._get(port, "/healthz")
+            assert code == 503
+            assert body["live"] is False
+            assert body["wedges"] == ["all_circuits_open"]
+        finally:
+            mgr.supervisors = {}
+            mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful handover: SIGTERM drains, releases, and the standby wins NOW
+# ---------------------------------------------------------------------------
+
+class TestGracefulHandover:
+    def test_stop_writes_final_then_releases(self, tmp_path):
+        clk = [100.0]
+        snap = str(tmp_path / "s.bin")
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=15.0, snap=snap)
+        provision(op, mgr, clk)
+        mgr.stop()
+        assert mgr.phase == "DRAINING"
+        assert led.releases == 1
+        sections, reason = load_sections(snap)
+        assert reason == "ok"        # the final snapshot landed, fenced
+        assert sections["meta"]["fence_epoch"] == led.fence_epoch()
+        # the successor wins at the SAME virtual instant: failover is
+        # one election round, not a TTL timeout
+        b = elector(tmp_path, "b", clk, ttl=15.0)
+        assert b.try_acquire()
+        assert b.fence_epoch() == led.fence_epoch() + 1
+
+    def test_double_stop_is_idempotent(self, tmp_path):
+        clk = [100.0]
+        snap = str(tmp_path / "s.bin")
+        op, mgr, led = ha_stack(tmp_path, clk, ttl=15.0, snap=snap)
+        provision(op, mgr, clk)
+        mgr.stop()
+        digest = hashlib.sha256(open(snap, "rb").read()).hexdigest()
+        mgr.stop()
+        assert led.releases == 1
+        assert hashlib.sha256(
+            open(snap, "rb").read()).hexdigest() == digest
+        assert mgr.fence.refusals == {}   # no spurious refusal noise
+
+
+# ---------------------------------------------------------------------------
+# the two-process kill -9 failover drill (the PR's acceptance test)
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import hashlib, json, os, signal, sys
+sys.path.insert(0, {repo!r})
+from karpenter_tpu.operator import (ControllerManager, Operator, Options,
+                                    build_controllers)
+from karpenter_tpu.operator.manager import LeaderElector
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.catalog.generate import generate_catalog
+from karpenter_tpu.cloud.fake import (ImageInfo, SecurityGroupInfo,
+                                      SubnetInfo)
+from karpenter_tpu.state.snapshot import write_snapshot
+from karpenter_tpu.utils.chaos import CHAOS, ChaosRule
+from karpenter_tpu.utils.fencing import LeaseFence, StaleFenceError
+
+snap, plan, lease = sys.argv[1], sys.argv[2], sys.argv[3]
+total, kill_after, ident = int(sys.argv[4]), int(sys.argv[5]), sys.argv[6]
+resume = kill_after < 0 and os.path.exists(plan) and \
+    os.path.getsize(plan) > 0
+start_tick = 0
+if resume:
+    with open(plan) as fh:
+        start_tick = sum(1 for _ in fh)
+
+TTL = 1.0   # < the 1.1s tick spacing: a dead leader's lease is expired
+#             by the standby's first tick — failover inside one tick
+clk = [1000.0 + 1.1 * start_tick]
+
+# the chaos storm the kill lands inside: every CreateFleet errors over the
+# middle third of the run.  rate=1.0 never consumes an RNG draw, so the
+# schedule is a pure function of the virtual clock — a resumed process
+# replays the identical storm (per-rule RNG streams do NOT survive a
+# kill -9; a fractional rate here would diverge the plan stream).
+s0, s1 = total // 3, (2 * total) // 3
+CHAOS.configure([ChaosRule(point="cloud.api", key="create_fleet",
+                           action="error", rate=1.0,
+                           at_s=1000.0 + 1.1 * (s0 + 1) - 0.05,
+                           until_s=1000.0 + 1.1 * (s1 + 1) - 0.05)],
+                seed=7, clock=lambda: clk[0])
+opts = Options(snapshot_path=snap)
+opts.feature_gates.update({{"WarmRestart": True, "HAFailover": True}})
+op = Operator(opts, catalog=generate_catalog(10), clock=lambda: clk[0])
+op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {{}}),
+                    SubnetInfo("s-b", "zone-b", 10_000, {{}})]
+op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {{}})]
+op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+op.params.parameters = {{
+    "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}}
+leader = LeaderElector(lease, ident, ttl=TTL, clock=lambda: clk[0])
+mgr = ControllerManager(op, build_controllers(op), clock=lambda: clk[0],
+                        leader=leader)
+
+outcome = mgr.startup()   # the readiness ladder, exactly as __main__ runs it
+print(f"STARTUP {{outcome}} {{mgr.probe_outcome}} {{mgr.phase}}", flush=True)
+if resume:
+    assert outcome == "restored", outcome
+    assert mgr.probe_outcome == "ok", mgr.probe_outcome
+
+for k in range(start_tick, total):
+    clk[0] = 1000.0 + 1.1 * (k + 1)
+    if k % 3 == 0:
+        op.cluster.add_pods([
+            Pod(name=f"p-{{k}}-{{i}}",
+                requests=ResourceList({{CPU: 500, MEMORY: 512 * 2**20}}))
+            for i in range(2)])
+    mgr.tick()
+    assert mgr.phase == "LEADING", mgr.phase
+    line = {{"k": k,
+             "nodes": sorted(op.cluster.nodes),
+             "bound": sorted(p.name for p in op.cluster.pods.values()
+                             if p.node_name),
+             "running": sorted(i.id for i in op.cloud.running())}}
+    with open(plan, "a") as fh:
+        fh.write(json.dumps(line, sort_keys=True) + "\n")
+    assert write_snapshot(snap, op, mgr, fence=mgr.fence)
+    if k == kill_after:
+        os.kill(os.getpid(), signal.SIGKILL)   # the real thing: no atexit,
+        #                                        no finally, no flushes
+print(f"DONE promotions={{mgr.promotions}}", flush=True)
+
+if resume:
+    # ghost phase: a stale incarnation of the dead leader comes back and
+    # tries to write.  EVERY write must be refused, on the counters, with
+    # zero bytes landing — refusal proven, not inferred.
+    before = hashlib.sha256(open(snap, "rb").read()).hexdigest()
+    ghost = LeaderElector(lease, "alpha", ttl=TTL, clock=lambda: clk[0])
+    ghost._epoch = 1          # the epoch alpha led under, long superseded
+    gf = LeaseFence(ghost)
+    assert write_snapshot(snap, op, mgr, fence=gf) is False
+    after = hashlib.sha256(open(snap, "rb").read()).hexdigest()
+    assert before == after, "stale-fenced write mutated the snapshot"
+    cloud = op.cloud_provider
+    live_fence = cloud.fence
+    cloud.fence = gf
+    victims = [c for c in cloud.list() if c.provider_id]
+    running_before = len(op.cloud.running())
+    refused = 0
+    try:
+        cloud.delete(victims[0])
+    except StaleFenceError:
+        refused = 1
+    cloud.fence = live_fence
+    assert len(op.cloud.running()) == running_before
+    print("GHOST " + json.dumps({{"refusals": dict(sorted(gf.refusals.items())),
+                                  "terminate_refused": refused}},
+                                sort_keys=True), flush=True)
+"""
+
+
+@pytest.mark.scale
+def test_two_process_failover_drill(tmp_path):
+    """The acceptance drill.  Process alpha leads a deterministic driver
+    and is SIGKILL'd mid-run; process beta promotes through the full
+    readiness ladder (warm restore + arena parity probe + election) and
+    finishes the run.  The concatenated plan stream must be
+    byte-identical to an uninterrupted run, and a ghost incarnation of
+    alpha must have every snapshot/terminate attempt refused on the
+    fencing counters with zero bytes landing."""
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD.format(repo=REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    total = int(os.environ.get("KARPENTER_TPU_FAILOVER_TICKS", "12"))
+    # SIGKILL lands at the midpoint — inside the CreateFleet chaos storm the
+    # child arms over the middle third of the run ("kill -9 mid-storm").
+    kill_at = total // 2
+
+    def run(snap, plan, lease, kill, ident):
+        return subprocess.run(
+            [sys.executable, str(child), str(snap), str(plan), str(lease),
+             str(total), str(kill), ident],
+            capture_output=True, text=True, env=env, timeout=300)
+
+    # A: uninterrupted single leader
+    pa = tmp_path / "plan_a.jsonl"
+    proc = run(tmp_path / "snap_a.bin", pa, tmp_path / "a.lease", -1,
+               "solo")
+    assert proc.returncode == 0, proc.stderr
+    assert "DONE" in proc.stdout
+
+    # B: leader alpha killed -9 mid-run, standby beta promotes
+    sb, pb = tmp_path / "snap_b.bin", tmp_path / "plan_b.jsonl"
+    lb = tmp_path / "b.lease"
+    proc = run(sb, pb, lb, kill_at, "alpha")
+    assert proc.returncode == -signal.SIGKILL
+    assert len(pb.read_text().splitlines()) == kill_at + 1
+
+    proc = run(sb, pb, lb, -1, "beta")
+    assert proc.returncode == 0, proc.stderr
+    # beta walked the whole ladder: restored, parity-probed ok, and its
+    # first tick promoted it out of STANDBY (alpha's lease had expired).
+    # promotions=2 because the counter is cumulative across the lineage:
+    # alpha's own startup promotion rides in through the snapshot, and
+    # beta's failover promotion adds the second.
+    assert "STARTUP restored ok STANDBY" in proc.stdout
+    assert "DONE promotions=2" in proc.stdout
+
+    # the plan streams are byte-identical across the kill -9 failover
+    assert pa.read_text() == pb.read_text(), (
+        "plan stream diverged across kill -9 + fenced failover")
+    last = json.loads(pa.read_text().splitlines()[-1])
+    assert last["nodes"] and last["bound"] and last["running"]
+
+    # the ghost's refusal counters prove zero stale writes landed
+    ghost_line = [ln for ln in proc.stdout.splitlines()
+                  if ln.startswith("GHOST ")]
+    assert ghost_line, proc.stdout
+    ghost = json.loads(ghost_line[0][len("GHOST "):])
+    assert ghost["refusals"]["snapshot"] == 1
+    assert ghost["refusals"]["terminate"] == 1
+    assert ghost["terminate_refused"] == 1

@@ -939,3 +939,50 @@ def test_rs_dynamic_names_are_not_flagged():
     out = RobustnessChecker().check_file(
         _sf(src, "karpenter_tpu/controllers/x.py"))
     assert _rules(out) == []
+
+
+def test_rs004_unfenced_mutation_call_sites_flagged():
+    """Every spelling of the guarded seams outside the funnel modules:
+    bare and module-qualified write_snapshot, and the cloud mutation
+    methods on whatever object holds the substrate."""
+    src = """
+        from karpenter_tpu.state.snapshot import write_snapshot
+        from karpenter_tpu.state import snapshot as snap_mod
+
+        def sneaky(op, mgr, cloud):
+            write_snapshot("/tmp/x.bin", op, mgr)
+            snap_mod.write_snapshot("/tmp/y.bin", op, mgr)
+            cloud.create_fleet([], count=1, tags={})
+            cloud.terminate_instances(["i-1"])
+    """
+    out = RobustnessChecker().check_file(
+        _sf(src, "karpenter_tpu/controllers/x.py"))
+    assert _rules(out) == ["RS004", "RS004", "RS004", "RS004"]
+    assert sorted(f.detail for f in out) == [
+        "create_fleet", "terminate_instances", "write_snapshot",
+        "write_snapshot"]
+
+
+def test_rs004_funnel_modules_are_exempt():
+    """The fence-checked funnels themselves are the sanctioned callers."""
+    src = """
+        def funnel(op, mgr, cloud):
+            write_snapshot("/tmp/x.bin", op, mgr)
+            cloud.create_fleet([], count=1, tags={})
+            cloud.terminate_instances(["i-1"])
+    """
+    for rel in ("karpenter_tpu/state/snapshot.py",
+                "karpenter_tpu/cloud/provider.py",
+                "karpenter_tpu/cloud/batcher.py"):
+        assert _rules(RobustnessChecker().check_file(_sf(src, rel))) == []
+
+
+def test_rs004_repo_funnels_stay_closed():
+    """The real package has ZERO unfenced mutation call sites: every
+    write_snapshot / create_fleet / terminate_instances call lives inside
+    an exempt funnel module.  A new call site anywhere else shows up here
+    before it ships an unfenced write."""
+    checker = RobustnessChecker()
+    hits = [f for sf in iter_sources(REPO)
+            for f in checker.check_file(sf) if f.rule == "RS004"]
+    assert hits == [], "\n".join(f.render() for f in hits)

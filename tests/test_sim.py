@@ -250,6 +250,11 @@ GOLDEN_CASES = [
     # short prefix — the full horizon runs gated (`make soak-smoke`,
     # `bench.py --soak`)
     ("long-soak", "long-soak.yaml", 120.0),
+    # fenced leadership under a lease blackout: skipped ticks, epoch
+    # bumps on re-election, and the report's "ha" section are part of
+    # the golden (the two-process kill -9 drill lives in
+    # tests/test_failover.py)
+    ("failover-drill", "failover-drill.yaml", 5400.0),
 ]
 
 
@@ -359,6 +364,27 @@ def test_golden_report_device_decode_gate_on(name):
         assert got == fh.read(), (
             f"device_decode=on report for {fname} diverged from {path}: "
             f"the gate changed behavior, not just decode latency")
+
+
+_NON_HA_CASES = [c for c in GOLDEN_CASES if c[0] != "failover-drill"]
+
+
+@pytest.mark.parametrize("name,fname,duration", _NON_HA_CASES,
+                         ids=[c[0] for c in _NON_HA_CASES])
+def test_golden_report_ha_gate_off(name, fname, duration):
+    """HAFailover defaults OFF; the explicit off-override must leave every
+    pre-existing canned scenario's report byte-identical — fencing and the
+    readiness ladder cannot perturb a run with no leader wired.  (The
+    failover-drill scenario is the one that turns the gate ON; its golden
+    pins the gate-on behavior instead.)"""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     ha_failover=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"ha_failover=off report for {fname} diverged from {path}")
 
 
 def test_golden_report_ingest_batch_gate_on():
