@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke incident-smoke deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -67,6 +67,10 @@ soak-smoke: ## Truncated soak gate + the durability suites: snapshot/warm-restar
 failover-smoke: ## Replay the failover-drill scenario + the HA suite incl. the truncated two-process kill -9 drill (docs/robustness.md)
 	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/failover-drill.yaml --seed 0 > /dev/null
 	JAX_PLATFORMS=cpu KARPENTER_TPU_FAILOVER_TICKS=8 $(PYTEST) tests/test_failover.py -q
+
+incident-smoke: ## Replay chaos-storm with the flight recorder armed + run the incident suite (docs/observability.md)
+	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/chaos-storm.yaml --seed 0 --flight-recorder > /dev/null
+	$(PYTEST) tests/test_incidents.py -q
 
 deflake: ## Run the suite 5x to shake out order/timing flakes (Makefile:106-109)
 	for i in 1 2 3 4 5; do $(PYTEST) tests/ -q -p no:randomly || exit 1; done

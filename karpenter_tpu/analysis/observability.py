@@ -45,6 +45,11 @@ rule("OB005", "observability",
      "dynamic span name bypasses the registry",
      "wrap the expression in tracing.registered(...) so membership is "
      "asserted at runtime, or switch to a literal from SPAN_NAMES")
+rule("OB006", "observability",
+     "trip-site counter incremented without publishing to the incident bus",
+     "call obs.incidents.publish_incident(kind, detail) in the same "
+     "function that increments the trip counter — the flight recorder "
+     "only captures what the bus sees (RS004-style funnel rule)")
 
 METRICS_MODULE = "karpenter_tpu/utils/metrics.py"
 TRACING_MODULE = "karpenter_tpu/utils/tracing.py"
@@ -54,6 +59,49 @@ UNBOUNDED_LABELS = {"pod", "pod_name", "uid", "provider_id", "instance_id",
                     "trace_id", "span_id", "request_id", "message_id"}
 
 _ROW_RE = re.compile(r"^\|\s*`([a-z0-9_*]+)`")
+
+# OB006: metric factories whose `.inc()` marks a fault-handling trip
+# site.  Every increment site must also publish to the incident bus —
+# otherwise the flight recorder has a blind spot for exactly the events
+# it exists to capture.  The obs/ package itself is exempt (it IS the
+# bus; the recorder increments bundle/suppression counters there).
+TRIP_FAMILIES = frozenset({
+    "supervisor_quarantines",     # circuit opened / controller quarantined
+    "watchdog_trips",             # hard deadline abandoned a phase
+    "leader_fence_refusals",      # stale fencing epoch refused a mutation
+    "degradation_transitions",    # SolverHealth ladder moved
+    "decode_transitions",         # DecodeHealth breaker moved
+})
+
+_OB006_EXEMPT_PREFIX = "karpenter_tpu/obs/"
+
+
+def _trip_inc_family(node: ast.AST) -> Optional[str]:
+    """`metrics.watchdog_trips().inc(...)` → "watchdog_trips"; None for
+    any call that is not a trip-family increment."""
+    if not (isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute) and
+            node.func.attr == "inc"):
+        return None
+    inner = node.func.value
+    if not isinstance(inner, ast.Call):
+        return None
+    f = inner.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else ""
+    return name if name in TRIP_FAMILIES else None
+
+
+def _publishes_incident(func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            f.id if isinstance(f, ast.Name) else ""
+        if name == "publish_incident":
+            return True
+    return False
 
 
 def registered_families(metrics_sf: SourceFile
@@ -149,6 +197,34 @@ class ObservabilityChecker(Checker):
             if sf.rel == TRACING_MODULE:
                 continue    # the registry itself; Tracer.span(name) is the API
             findings.extend(self._check_spans(sf, spans))
+        for sf in sources:
+            findings.extend(self._check_trip_funnel(sf))
+        return findings
+
+    def _check_trip_funnel(self, sf: SourceFile) -> List[Finding]:
+        """OB006: every trip-counter increment shares a function with a
+        publish_incident call.  Lexical like RS004 — the contract is
+        that the SAME code path feeds both the metric and the bus."""
+        if sf.rel.startswith(_OB006_EXEMPT_PREFIX) or \
+                sf.rel == METRICS_MODULE:
+            return []
+        findings: List[Finding] = []
+        parents = sf.parents()
+        for node in ast.walk(sf.tree):
+            family = _trip_inc_family(node)
+            if family is None:
+                continue
+            func: Optional[ast.AST] = node
+            while func is not None and not isinstance(
+                    func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = parents.get(func)
+            if func is not None and _publishes_incident(func):
+                continue
+            findings.append(Finding(
+                "OB006", sf.rel, node.lineno, sf.scope_of(node), family,
+                f"trip counter {family} incremented without a "
+                "publish_incident in the same function — the flight "
+                "recorder cannot see this trip"))
         return findings
 
     def _check_metrics_docs(self, metrics_sf: SourceFile,

@@ -1,0 +1,28 @@
+"""Flight recorder: incident trigger bus, metric history ring, bundles.
+
+The fault-handling stack (supervisor circuits, watchdogs, the solver and
+decode ladders, fencing refusals, cold-restore fallbacks) already *counts*
+everything, but counters are point-in-time: by the time a human looks at
+a 3am circuit-open, the evidence is gone.  This package captures it at
+the moment of the trip:
+
+  * `incidents` — the process-global trigger bus every trip site
+    publishes to (`publish_incident`).  Disarmed by default: a single
+    boolean check and the trip site has paid its entire cost.
+  * `ring` — a bounded metrics time-series ring sampled on the
+    *injectable* clock, so the sim records virtual time deterministically
+    and DT001 stays clean.
+  * `bundle` — atomic (tmp + os.replace) forensic bundle files with
+    bounded retention and corruption-tolerant read-back.
+  * `recorder` — the `FlightRecorder` that ties them together behind the
+    `FlightRecorder` feature gate (default off; gate-off runs are
+    byte-identical).
+
+Import discipline: `incidents` is stdlib-only so the low-level trip
+sites (utils/watchdog.py, utils/fencing.py, ops/health.py, …) can import
+it without cycles; only `recorder` reaches back into utils.
+"""
+
+from .incidents import BUS, INCIDENT_KINDS, IncidentBus, publish_incident
+
+__all__ = ["BUS", "INCIDENT_KINDS", "IncidentBus", "publish_incident"]

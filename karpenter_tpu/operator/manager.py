@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from ..obs.incidents import publish_incident
 from ..utils import metrics, tracing
 from ..utils.chaos import CHAOS
 from .options import Options
@@ -129,6 +130,9 @@ class LeaderElector:
             self._leading = False
             self.losses += 1
             metrics.leader_transitions().inc({"event": "lost"})
+            publish_incident("leader_loss", {
+                "identity": self.identity, "epoch": self._epoch,
+                "losses": self.losses})
 
     def try_acquire(self) -> bool:
         """Read-decide-write under a kernel flock so two replicas racing at
@@ -363,6 +367,58 @@ class ControllerManager:
                 cloud.fence = self.fence
             if self._snapshotter is not None:
                 self._snapshotter.fence = self.fence
+        # incident flight recorder (karpenter_tpu/obs/, FlightRecorder
+        # gate): metric-history ring sampled each tick on this manager's
+        # injectable clock + the process-global trip-site trigger bus.
+        # Gate off → `self.flight is None` and the bus stays disarmed, so
+        # every trip site pays one boolean check and nothing else.
+        self.flight = None
+        if operator.options.gate("FlightRecorder"):
+            from ..obs.recorder import FlightRecorder
+            o = operator.options
+            self.flight = FlightRecorder(
+                clock,
+                cadence_s=getattr(o, "obs_sample_s", 30.0),
+                window_s=getattr(o, "incident_window_s", 600.0),
+                dedup_s=getattr(o, "incident_dedup_s", 300.0),
+                retention=getattr(o, "incident_retention", 32),
+                ring_slots=getattr(o, "obs_ring_slots", 512),
+                dirpath=getattr(o, "incident_dir", "") or None)
+            self.flight.health_cb = self.health_snapshot
+            self.flight.chaos_cb = self._chaos_state
+            self.flight.fence_cb = self._fence_state
+            self.flight.provenance_cb = self._provenance_records
+            self.flight.traces_cb = tracing.TRACER.traces
+            self.flight.arm()
+
+    def _chaos_state(self) -> Dict:
+        return {"enabled": CHAOS.enabled, "counts": CHAOS.counts(),
+                "fired_total": CHAOS.fired_total()}
+
+    def _fence_state(self) -> Dict:
+        out: Dict[str, object] = {
+            "epoch": self.leader.fence_epoch()
+            if self.leader is not None else None,
+            "phase": self.phase,
+            "skipped_ticks": self._skipped_ticks,
+            "midtick_aborts": self._midtick_aborts,
+            "lease_errors": self._lease_errors,
+        }
+        if self.fence is not None:
+            out["refusals"] = dict(self.fence.refusals)
+        return out
+
+    def _provenance_records(self, pods: List[str]) -> List[Dict]:
+        """Provenance context for a bundle: the named pods' records, or
+        (when the trip names none) the most recent records, bounded."""
+        store = getattr(self.operator, "provenance", None)
+        if store is None:
+            return []
+        if pods:
+            recs = [r for r in (store.get(p) for p in pods) if r is not None]
+        else:
+            recs = store.all()[-20:]
+        return [r.to_dict() for r in recs]
 
     def _nodeclass_tick(self, ctrl):
         def run():
@@ -386,6 +442,13 @@ class ControllerManager:
                 # otherwise log thousands of identical tracebacks.
                 self._lease_errors += 1
                 metrics.leader_lease_errors().inc()
+                # published per error, deduped per kind by the bus — a
+                # blackout window yields a tiling of bundles (window_s >
+                # dedup_s), not one per tick and not just the first
+                publish_incident("leader_loss", {
+                    "reason": "lease_io_error",
+                    "error": f"{type(err).__name__}: {err}",
+                    "lease_errors": self._lease_errors})
                 if self._lease_err_streak == 0:
                     log.warning("lease I/O failed; skipping ticks until it "
                                 "recovers: %s", err)
@@ -409,6 +472,11 @@ class ControllerManager:
     def _tick_locked(self) -> Dict[str, object]:
         now = self.clock()
         results: Dict[str, object] = {}
+        # flight-recorder history sample: cadence-bounded, read-only over
+        # the metric registry, and safe before the lease guard (a deposed
+        # replica's history is exactly what the post-mortem wants)
+        if self.flight is not None:
+            self.flight.sample()
         # mid-tick lease guard: waiting on the state lock may have eaten
         # the whole lease; a deposed tick must abort before any mutation
         if not self._lease_live():
@@ -586,6 +654,9 @@ class ControllerManager:
                 outcome = "ok" if same else "mismatch"
                 if not same:
                     arena.invalidate("parity_probe")
+                    publish_incident("parity_mismatch", {
+                        "sampled_pods": len(reps),
+                        "phase": self.phase})
                     log.error("arena parity probe FAILED: warm gather "
                               "diverges from cold tensorize; arena "
                               "invalidated")
@@ -665,6 +736,19 @@ class ControllerManager:
             "lease_errors": self._lease_errors,
         }
 
+    def incidents_snapshot_state(self) -> Optional[Dict]:
+        """Flight-recorder cursor + dedup state for the WarmRestart
+        snapshot (None when the FlightRecorder gate is off).  Carrying
+        the dedup clocks forward is what keeps a warm restart from
+        re-publishing incidents the predecessor already bundled."""
+        if self.flight is None:
+            return None
+        return self.flight.snapshot_state()
+
+    def incidents_restore_state(self, data: Dict) -> None:
+        if self.flight is not None and data:
+            self.flight.restore_state(data)
+
     def ha_restore_state(self, data: Dict) -> None:
         """Restore the HA counters (phase itself is NOT restored: the
         restoring process is walking its own readiness ladder and must
@@ -703,6 +787,8 @@ class ControllerManager:
                 except Exception:
                     log.warning("lease release failed during drain",
                                 exc_info=True)
+        if self.flight is not None:
+            self.flight.disarm()
         if self._http is not None:
             self._http.shutdown()
         refinery = getattr(self.controllers.get("provisioning"), "refinery",
@@ -901,14 +987,40 @@ class ControllerManager:
                     ctype = "text/plain; version=0.0.4"
                 elif url.path == "/debug/traces":
                     # recent completed traces from the tracer ring buffer,
-                    # ?min_ms= filters out fast ones
+                    # ?min_ms= filters out fast ones, ?span= keeps only
+                    # traces whose root span name starts with the prefix
+                    query = parse_qs(url.query)
                     try:
-                        min_ms = float(
-                            parse_qs(url.query).get("min_ms", ["0"])[0])
+                        min_ms = float(query.get("min_ms", ["0"])[0])
                     except ValueError:
                         self._json({"error": "min_ms must be a number"}, 400)
                         return
-                    self._json({"traces": tracing.TRACER.traces(min_ms)})
+                    span = query.get("span", [None])[0]
+                    self._json({"traces":
+                                tracing.TRACER.traces(min_ms, span=span)})
+                    return
+                elif url.path == "/debug/incidents":
+                    # flight-recorder bundle index + bus/ring counters
+                    if manager.flight is None:
+                        self._json({"error": "flight recorder disabled; "
+                                             "start with --flight-recorder"},
+                                   404)
+                        return
+                    self._json(manager.flight.summary())
+                    return
+                elif url.path.startswith("/debug/incidents/"):
+                    # one full forensic bundle by id
+                    if manager.flight is None:
+                        self._json({"error": "flight recorder disabled; "
+                                             "start with --flight-recorder"},
+                                   404)
+                        return
+                    bid = url.path[len("/debug/incidents/"):]
+                    bundle = manager.flight.get_bundle(bid)
+                    if bundle is None:
+                        self._json({"error": f"no bundle {bid!r}"}, 404)
+                        return
+                    self._json(bundle)
                     return
                 elif url.path == "/debug/health":
                     # supervisor circuits + solver degradation ladder

@@ -98,6 +98,14 @@ class Options:
     # flips only after the restore + arena-parity-probe ladder.  Off by
     # default; enable with --ha-failover or --feature-gates
     # HAFailover=true (pair with --leader-elect + --lease-path).
+    # FlightRecorder: the incident flight recorder (karpenter_tpu/obs/,
+    # docs/observability.md) — a metric-history ring sampled on the
+    # injectable clock plus a trip-site trigger bus that captures an
+    # atomic forensic bundle (metric deltas, trace ring, health/chaos/
+    # fencing state) on circuit opens, watchdog trips, ladder demotions,
+    # fence refusals, cold restores, parity mismatches, and leader loss.
+    # Off by default; enable with --flight-recorder or --feature-gates
+    # FlightRecorder=true.  Knobs below.
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
                                  "LPRefinery": False, "Forecast": False,
@@ -106,7 +114,8 @@ class Options:
                                  "WarmRestart": False,
                                  "IngestBatch": False,
                                  "DeviceDecode": False,
-                                 "HAFailover": False})
+                                 "HAFailover": False,
+                                 "FlightRecorder": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -142,6 +151,13 @@ class Options:
     lease_path: str = ""                    # lease file ("" = derive from
                                             # cluster name in tmpdir)
     lease_ttl_s: float = 15.0               # leadership lease TTL
+    # flight-recorder knobs (FlightRecorder gate, docs/observability.md)
+    obs_sample_s: float = 30.0              # metric-ring sampling cadence
+    obs_ring_slots: int = 512               # bounded ring capacity
+    incident_window_s: float = 600.0        # forensic lookback per bundle
+    incident_dedup_s: float = 300.0         # per-kind publish rate limit
+    incident_retention: int = 32            # bundles kept (memory + disk)
+    incident_dir: str = ""                  # bundle directory ("" = memory-only)
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
@@ -307,6 +323,33 @@ class Options:
         p.add_argument("--lease-ttl", type=float, dest="lease_ttl_s",
                        default=env.get("lease_ttl_s", 15.0),
                        help="leadership lease TTL in seconds")
+        p.add_argument("--flight-recorder", action="store_true", default=False,
+                       help="arm the incident flight recorder: metric "
+                            "history ring + trip-site trigger bus + "
+                            "forensic bundles (shorthand for "
+                            "--feature-gates FlightRecorder=true)")
+        p.add_argument("--incident-dir",
+                       default=env.get("incident_dir", ""),
+                       help="directory for forensic incident bundles "
+                            "(empty keeps them in-memory only)")
+        p.add_argument("--incident-window", type=float,
+                       dest="incident_window_s",
+                       default=env.get("incident_window_s", 600.0),
+                       help="seconds of metric/trace history folded into "
+                            "each forensic bundle")
+        p.add_argument("--incident-dedup", type=float,
+                       dest="incident_dedup_s",
+                       default=env.get("incident_dedup_s", 300.0),
+                       help="per-kind incident rate-limit window in seconds")
+        p.add_argument("--incident-retention", type=int,
+                       default=env.get("incident_retention", 32),
+                       help="forensic bundles retained (memory and disk)")
+        p.add_argument("--obs-sample", type=float, dest="obs_sample_s",
+                       default=env.get("obs_sample_s", 30.0),
+                       help="metric history ring sampling cadence in seconds")
+        p.add_argument("--obs-ring-slots", type=int,
+                       default=env.get("obs_ring_slots", 512),
+                       help="metric history ring capacity in samples")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -347,6 +390,12 @@ class Options:
             ingest_max_events=ns.ingest_max_events,
             lease_path=ns.lease_path,
             lease_ttl_s=ns.lease_ttl_s,
+            obs_sample_s=ns.obs_sample_s,
+            obs_ring_slots=ns.obs_ring_slots,
+            incident_window_s=ns.incident_window_s,
+            incident_dedup_s=ns.incident_dedup_s,
+            incident_retention=ns.incident_retention,
+            incident_dir=ns.incident_dir,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
@@ -369,6 +418,8 @@ class Options:
         if ns.ha_failover:
             opts.feature_gates["HAFailover"] = True
             opts.leader_elect = True  # fencing is meaningless without a lease
+        if ns.flight_recorder:
+            opts.feature_gates["FlightRecorder"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts
@@ -408,6 +459,11 @@ class Options:
             "snapshot_interval_s": float,
             "ingest_max_events": int,
             "lease_ttl_s": float,
+            "obs_sample_s": float,
+            "obs_ring_slots": int,
+            "incident_window_s": float,
+            "incident_dedup_s": float,
+            "incident_retention": int,
         }
         for f in fields(Options):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())

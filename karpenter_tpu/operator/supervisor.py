@@ -30,6 +30,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
+from ..obs.incidents import publish_incident
 from ..utils import metrics
 
 log = logging.getLogger("karpenter_tpu.supervisor")
@@ -130,6 +131,9 @@ class ControllerSupervisor:
         self._set_state(OPEN)
         self.total_quarantines += 1
         metrics.supervisor_quarantines().inc({"controller": self.name})
+        publish_incident("circuit_open", {
+            "controller": self.name, "failures": self.failures,
+            "last_error": self.last_error, "retry_at": self.retry_at})
         msg = f"controller quarantined: {self.last_error}"
         log.warning("%s: %s (%d consecutive failures, retry at %.1f)",
                     self.name, msg, self.failures, self.retry_at)

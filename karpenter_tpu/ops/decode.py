@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs.incidents import publish_incident
 from ..utils import metrics
 
 log = logging.getLogger("karpenter_tpu.decode")
@@ -131,6 +132,10 @@ class DecodeHealth:
         key = f"{event}:{reason}"
         self.transitions[key] = self.transitions.get(key, 0) + 1
         metrics.decode_transitions().inc({"event": event, "reason": reason})
+        if event != "recovered":
+            publish_incident("decode_demotion", {
+                "reason": reason, "demotions": self.demotions,
+                "transitions": dict(self.transitions)})
         if event == "recovered":
             log.info("device decode recovered")
 

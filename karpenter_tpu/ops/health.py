@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
+from ..obs.incidents import publish_incident
 from ..utils import metrics, tracing
 
 log = logging.getLogger("karpenter_tpu.health")
@@ -131,6 +132,10 @@ class SolverHealth:
         self.transitions[key] = self.transitions.get(key, 0) + 1
         metrics.degradation_transitions().inc(
             {"from": frm, "to": to, "reason": reason})
+        if reason != "recovered":
+            publish_incident("solver_demotion", {
+                "from": frm, "to": to, "reason": reason,
+                "transitions": dict(self.transitions)})
         tracing.annotate(degradation=key)
         if reason == "recovered":
             log.info("solver ladder: rung %s recovered", frm)

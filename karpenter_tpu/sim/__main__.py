@@ -34,6 +34,9 @@ def main(argv=None) -> int:
                    help="write the event log (JSON lines) to this file")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="INFO-level controller logging")
+    p.add_argument("--flight-recorder", action="store_true",
+                   help="arm the incident flight recorder (FlightRecorder "
+                        "gate): the report grows an `incidents` section")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -46,7 +49,9 @@ def main(argv=None) -> int:
         print(f"scenario error: {e}", file=sys.stderr)
         return 2
     harness = SimHarness(scenario, seed=args.seed,
-                         duration_s=args.duration)
+                         duration_s=args.duration,
+                         flight_recorder=True if args.flight_recorder
+                         else None)
     run = harness.run()
 
     doc = report_to_json(run.report)

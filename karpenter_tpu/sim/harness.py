@@ -106,7 +106,8 @@ class SimHarness:
                  warm_restart: Optional[bool] = None,
                  ingest_batch: Optional[bool] = None,
                  device_decode: Optional[bool] = None,
-                 ha_failover: Optional[bool] = None):
+                 ha_failover: Optional[bool] = None,
+                 flight_recorder: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
         twice — knobs still come from the scenario's forecast block.
@@ -124,7 +125,11 @@ class SimHarness:
         overrides the HAFailover gate (default off): a virtual-clock
         LeaderElector is wired into the manager so lease expiry, fencing
         refusals, and `leader.lease` chaos replay deterministically —
-        goldens for non-HA scenarios are recorded with the gate off."""
+        goldens for non-HA scenarios are recorded with the gate off.
+        `flight_recorder` overrides the FlightRecorder gate (default
+        off): the incident bus arms, the metric ring samples on the
+        virtual clock, and the report grows a gated `incidents` section
+        — every golden is recorded with the gate off."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -155,6 +160,10 @@ class SimHarness:
             opts.feature_gates["IngestBatch"] = bool(ingest_batch)
         if device_decode is not None:
             opts.feature_gates["DeviceDecode"] = bool(device_decode)
+        self._fr_enabled = bool(flight_recorder) \
+            if flight_recorder is not None else False
+        if self._fr_enabled:
+            opts.feature_gates["FlightRecorder"] = True
         ha = scenario.ha
         self._ha_enabled = bool(ha_failover) if ha_failover is not None \
             else (ha is not None and ha.enabled)
@@ -496,6 +505,16 @@ class SimHarness:
 
     # ------------------------------------------------------------------
     def run(self) -> SimRun:
+        try:
+            return self._run_gated()
+        finally:
+            # the incident bus is process-global: it must not stay armed
+            # past this run, or the next harness/test would publish into
+            # a recorder whose clock and ring are gone
+            if self._fr_enabled and self.mgr.flight is not None:
+                self.mgr.flight.disarm()
+
+    def _run_gated(self) -> SimRun:
         if not self._chaos_enabled:
             return self._run_loop()
         ch = self.scenario.chaos

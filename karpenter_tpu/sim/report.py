@@ -182,6 +182,15 @@ def build_report(harness) -> Dict:
             "fence_refusals": dict(sorted(fence.refusals.items()))
             if fence is not None else {},
         }
+    if getattr(harness, "_fr_enabled", False) and \
+            getattr(harness.mgr, "flight", None) is not None:
+        # present ONLY when the FlightRecorder gate ran — same conditional
+        # contract as forecast/chaos/ha, so every recorder-off report
+        # (all pre-existing goldens) stays byte-identical.  The summary is
+        # deterministic: bundle ids are virtual-clock millisecond stamps,
+        # dedup windows follow the same clock, and no wall-clock payloads
+        # (trace timings, health latencies) are included.
+        report["incidents"] = harness.mgr.flight.summary()
     return report
 
 

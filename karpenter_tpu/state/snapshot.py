@@ -46,6 +46,7 @@ import pickle
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from ..obs.incidents import publish_incident
 from ..utils import metrics
 
 log = logging.getLogger("karpenter_tpu.snapshot")
@@ -142,6 +143,14 @@ def collect_sections(op, manager=None) -> Dict:
         ha = getattr(manager, "ha_snapshot_state", None)
         if ha is not None:
             sections["leader"] = ha()
+        # flight-recorder cursor + bus dedup state (FlightRecorder gate):
+        # the hook returns None when the gate is off, keeping gate-off
+        # snapshots byte-identical
+        inc = getattr(manager, "incidents_snapshot_state", None)
+        if inc is not None:
+            incidents = inc()
+            if incidents is not None:
+                sections["incidents"] = incidents
     sections["meta"] = {
         "version": VERSION,
         "written_at": op.clock(),
@@ -233,6 +242,8 @@ def restore_snapshot(path: str, op, manager=None) -> str:
         log.warning("snapshot restore from %s: cold fallback (%s)",
                     path, reason)
         metrics.snapshot_restores().inc({"outcome": reason})
+        publish_incident("snapshot_fallback", {"outcome": reason,
+                                               "path": path})
         return reason
     # pre-state for rollback: a half-applied restore must never leave a
     # structurally invalid cluster, so on ANY apply exception we put the
@@ -253,6 +264,8 @@ def restore_snapshot(path: str, op, manager=None) -> str:
         if op.cluster.arena is not None:
             op.cluster.arena.invalidate("restore_failed")
         metrics.snapshot_restores().inc({"outcome": "apply_error"})
+        publish_incident("snapshot_fallback", {"outcome": "apply_error",
+                                               "path": path})
         return "apply_error"
     age = max(0.0, op.clock() - float(sections["meta"]["written_at"]))
     metrics.snapshot_restores().inc({"outcome": "restored"})
@@ -310,6 +323,9 @@ def _apply_sections(sections: Dict, op, manager=None) -> None:
         ha = getattr(manager, "ha_restore_state", None)
         if ha is not None and sections.get("leader") is not None:
             ha(sections["leader"])
+        inc = getattr(manager, "incidents_restore_state", None)
+        if inc is not None and sections.get("incidents") is not None:
+            inc(sections["incidents"])
 
 
 # ---------------------------------------------------------------------------

@@ -7,6 +7,11 @@ reconcile through the controller manager, then fetches `/debug/traces`
 over HTTP — the same JSON a production scrape would see — and
 pretty-prints each trace tree with durations and annotations, plus the
 stuck pod's provenance record from `/debug/pods/<name>`.
+
+Runs with the FlightRecorder gate ON: after the reconciles it trips the
+solver degradation ladder once, then fetches the incident index from
+`/debug/incidents` and pretty-prints the newest forensic bundle — the
+`make incident-smoke` walkthrough (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -42,7 +47,9 @@ def render(span, depth=0, lines=None):
 
 def main() -> int:
     clock = [1000.0]
-    op = Operator(Options(batch_idle_duration=1.0, batch_max_duration=10.0),
+    opts = Options(batch_idle_duration=1.0, batch_max_duration=10.0)
+    opts.feature_gates["FlightRecorder"] = True
+    op = Operator(opts,
                   catalog=generate_catalog(20), clock=lambda: clock[0])
     op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 100, {}),
                         SubnetInfo("s-b", "zone-b", 100, {})]
@@ -90,6 +97,41 @@ def main() -> int:
         print(f"  constraint: {prov['constraint']}"
               + (f" ({prov['dimension']})" if prov["dimension"] else ""))
         print(f"  message:    {prov['message']}")
+
+        # ?span= prefix filter: only the disruption family of roots
+        filtered = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces?span=disruption.",
+            timeout=10).read())
+        print(f"\n# /debug/traces?span=disruption. — "
+              f"{len(filtered['traces'])} of {len(traces['traces'])} trace(s)")
+
+        # trip the solver degradation ladder (a watchdog-style timeout
+        # demotes immediately) so the flight recorder captures a bundle
+        health = getattr(mgr.controllers["provisioning"], "health", None)
+        if health is not None and mgr.flight is not None:
+            health.report_failure("jax", reason="timeout")
+            index = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/incidents",
+                timeout=10).read())
+            print(f"\n# /debug/incidents — {len(index['bundles'])} "
+                  f"bundle(s), by kind {index['by_kind']}")
+            newest = index["bundles"][-1]
+            bundle = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/incidents/{newest['id']}",
+                timeout=10).read())
+            print(f"\n# /debug/incidents/{newest['id']} — newest bundle")
+            print(f"  kind:    {bundle['kind']}  (detail: "
+                  f"{json.dumps(bundle['detail'], sort_keys=True)})")
+            print(f"  window:  [{bundle['window'][0]:.0f}, "
+                  f"{bundle['window'][1]:.0f}]  "
+                  f"ring entries: {bundle['ring_entries']}")
+            changed = bundle["metrics"].get("changed", {})
+            print(f"  metric deltas over the window: {len(changed)} series")
+            for key in sorted(changed)[:8]:
+                print(f"    {key:<58} {changed[key]:+g}")
+            print(f"  traces captured: {len(bundle['traces'])}; health "
+                  f"rungs: "
+                  f"{sorted(bundle['health']['solver']['rungs'])}")
         return 0
     finally:
         mgr.stop()

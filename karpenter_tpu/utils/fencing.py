@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 from typing import Dict
 
+from ..obs.incidents import publish_incident
 from . import metrics
 
 log = logging.getLogger("karpenter_tpu.fencing")
@@ -72,6 +73,9 @@ class LeaseFence:
                           "refusing", op)
         self.refusals[op] = self.refusals.get(op, 0) + 1
         metrics.leader_fence_refusals().inc({"op": op})
+        publish_incident("fence_refusal", {
+            "op": op, "epoch": self.elector.fence_epoch(),
+            "refusals": dict(self.refusals)})
         log.warning("stale fence: refused %s (epoch %d no longer holds "
                     "the lease)", op, self.elector.fence_epoch())
         return False

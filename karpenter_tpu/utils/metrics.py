@@ -199,6 +199,21 @@ class Registry:
             self._metrics.clear()
             self._collectors.clear()
 
+    def sample_all(self):
+        """Every sample of every registered family, as a flat sorted list
+        of ``(name, labelkv, value)`` — the flight recorder's history
+        ring snapshots this on the injectable-clock cadence.  Scrape-time
+        collectors are deliberately NOT run: they walk live cluster state
+        (per-node gauges) and exist for the scrape path; the ring wants a
+        cheap, side-effect-free pass over what the process already
+        counted."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = []
+        for m in sorted(metrics, key=lambda m: m.name):
+            out.extend(m.samples())
+        return out
+
     def expose(self) -> str:
         """Prometheus text exposition format.  Families with a legacy
         alias (the reference ships BOTH API generations' names,
@@ -1304,3 +1319,52 @@ def ready_probes() -> Counter:
         "karpenter_ready_probes_total",
         "Readiness arena parity probes, by outcome.",
         labels=("outcome",))
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder families (docs/observability.md) — the recorder only
+# touches these while the FlightRecorder gate is armed, so a gate-off
+# process never materializes the series.
+# ---------------------------------------------------------------------------
+
+def incident_bundles() -> Counter:
+    """Forensic bundles captured by the flight recorder, by incident
+    kind (`obs/incidents.py INCIDENT_KINDS` — the label set is a closed
+    registry, like chaos points and watchdog phases)."""
+    return REGISTRY.counter(
+        "karpenter_incident_bundles_total",
+        "Forensic incident bundles captured, by kind.",
+        labels=("kind",))
+
+
+def incident_suppressed() -> Counter:
+    """Trip-site publishes deduplicated inside the per-kind rate-limit
+    window — a chaos storm re-tripping the same circuit every tick
+    increments this, not the bundle counter."""
+    return REGISTRY.counter(
+        "karpenter_incident_suppressed_total",
+        "Incident publishes suppressed by per-kind dedup, by kind.",
+        labels=("kind",))
+
+
+def incident_write_errors() -> Counter:
+    """Bundle disk writes that failed (capture degraded to memory-only;
+    the incident record survives in-process, durability was lost)."""
+    return REGISTRY.counter(
+        "karpenter_incident_write_errors_total",
+        "Incident bundle disk-write failures (memory-only fallback).")
+
+
+def obs_ring_samples() -> Counter:
+    """Metric-history ring samples actually taken (cadence gate passed)."""
+    return REGISTRY.counter(
+        "karpenter_obs_ring_samples_total",
+        "Metric time-series ring samples taken.")
+
+
+def obs_ring_entries() -> Gauge:
+    """Samples currently held in the bounded history ring (saturates at
+    the configured slot count in steady state)."""
+    return REGISTRY.gauge(
+        "karpenter_obs_ring_entries",
+        "Samples currently held in the metric history ring.")

@@ -227,13 +227,18 @@ class Tracer:
             stack.pop()
 
     # ---- export ----
-    def traces(self, min_ms: float = 0.0) -> List[Dict[str, Any]]:
-        """Completed root traces, newest first, as JSON-ready dicts."""
+    def traces(self, min_ms: float = 0.0,
+               span: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Completed root traces, newest first, as JSON-ready dicts.
+        `span` keeps only traces whose root span name starts with the
+        given prefix (e.g. "controller." for the reconcile family)."""
         with self._lock:
             roots = list(self._ring)
         out = [r.to_dict() for r in reversed(roots)]
         if min_ms > 0:
             out = [t for t in out if t["duration_ms"] >= min_ms]
+        if span:
+            out = [t for t in out if str(t.get("name", "")).startswith(span)]
         return out
 
     def reset(self) -> None:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, TypeVar
 
+from ..obs.incidents import publish_incident
 from . import metrics
 
 T = TypeVar("T")
@@ -77,6 +78,8 @@ def run_with_deadline(fn: Callable[[], T], timeout_s: float,
     done.wait(timeout_s)
     if not done.is_set():
         metrics.watchdog_trips().inc({"phase": phase})
+        publish_incident("watchdog_trip",
+                         {"phase": phase, "timeout_s": timeout_s})
         raise WatchdogTimeout(phase, timeout_s)
     t.join()  # worker is past its try block; join returns immediately
     if "error" in box:

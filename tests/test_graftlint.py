@@ -566,6 +566,69 @@ def test_ob001_ob003_metrics_contract(tmp_path):
         {"undocumented_things", "ghost_total", "leaky_total:pod"}
 
 
+def test_ob006_trip_inc_without_publish_flagged():
+    user = _sf("""
+        from karpenter_tpu.utils import metrics
+
+        def quarantine(name):
+            metrics.supervisor_quarantines().inc({"controller": name})
+    """, "karpenter_tpu/operator/supervisor2.py")
+    out = ObservabilityChecker().check_repo([user], REPO)
+    ob6 = [f for f in out if f.rule == "OB006"]
+    assert [f.detail for f in ob6] == ["supervisor_quarantines"]
+
+
+def test_ob006_publish_in_same_function_is_clean():
+    user = _sf("""
+        from karpenter_tpu.obs import publish_incident
+        from karpenter_tpu.utils import metrics
+
+        def quarantine(name):
+            metrics.supervisor_quarantines().inc({"controller": name})
+            publish_incident("circuit_open", {"controller": name})
+
+        def other_trip(phase):
+            # a publish in a DIFFERENT function does not cover this inc
+            metrics.watchdog_trips().inc({"phase": phase})
+    """, "karpenter_tpu/operator/supervisor2.py")
+    out = ObservabilityChecker().check_repo([user], REPO)
+    assert [f.detail for f in out if f.rule == "OB006"] == \
+        ["watchdog_trips"]
+
+
+def test_ob006_non_trip_family_and_obs_package_exempt():
+    benign = _sf("""
+        from karpenter_tpu.utils import metrics
+
+        def count(name):
+            metrics.pods_bound().inc({"nodepool": name})
+    """, "karpenter_tpu/controllers/binder2.py")
+    obs = _sf("""
+        from karpenter_tpu.utils import metrics
+
+        def replay(phase):
+            metrics.watchdog_trips().inc({"phase": phase})
+    """, "karpenter_tpu/obs/replay.py")
+    out = ObservabilityChecker().check_repo([benign, obs], REPO)
+    assert [f for f in out if f.rule == "OB006"] == []
+
+
+def test_dt001_obs_package_sim_reachable_and_clean():
+    """The flight recorder runs inside the manager tick, so `obs/` is on
+    the sim replay path — the determinism rules must see it (reachable)
+    and it must be clean: the ring samples on the injectable clock and
+    the bus never reads the wall while disarmed."""
+    from karpenter_tpu.analysis.determinism import reachable_from_sim
+    sources = iter_sources(REPO)
+    reach = reachable_from_sim(sources)
+    for mod in ("karpenter_tpu.obs.incidents", "karpenter_tpu.obs.ring",
+                "karpenter_tpu.obs.bundle", "karpenter_tpu.obs.recorder"):
+        assert mod in reach, f"{mod} not sim-reachable: DT rules blind to it"
+    out = DeterminismChecker().check_repo(sources, REPO)
+    assert [f for f in out
+            if f.path.startswith("karpenter_tpu/obs/")] == []
+
+
 def test_real_span_names_match_repo_registry():
     """Every literal span name in the repo is registered — the live check
     the OB004 rule enforces, asserted directly for a clear failure."""

@@ -1,0 +1,460 @@
+"""Incident flight recorder suite (docs/observability.md): trigger-bus
+semantics (dedup, concurrent storms, sink faults), the metrics history
+ring, forensic-bundle atomicity / corruption read-back / retention, the
+recorder's episode extension and warm-restart carry — and the
+end-to-end sim captures: chaos-storm and failover-drill with the gate
+ON produce deduplicated bundles covering the injected trip intervals,
+and every canned golden stays byte-identical with the gate OFF.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from karpenter_tpu.obs import BUS, INCIDENT_KINDS, publish_incident
+from karpenter_tpu.obs.bundle import (bundle_id, bundle_path,
+                                      list_bundle_ids, prune, read_bundle,
+                                      write_bundle)
+from karpenter_tpu.obs.recorder import FlightRecorder
+from karpenter_tpu.obs.ring import MetricsRing, series_key
+from karpenter_tpu.sim import SimHarness, load_scenario, report_to_json
+
+pytestmark = pytest.mark.sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCENARIOS = os.path.join(REPO, "scenarios")
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    """The bus is process-global (the whole point — trip sites publish
+    without plumbing); keep tests hermetic by disarming around each."""
+    BUS.disarm()
+    yield
+    BUS.disarm()
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class FakeRegistry:
+    """Minimal `sample_all()` source so ring tests control every value."""
+
+    def __init__(self):
+        self.series = {}
+
+    def set(self, name, value, labels=()):
+        self.series[(name, tuple(labels))] = float(value)
+
+    def sample_all(self):
+        return [(name, labels, v)
+                for (name, labels), v in sorted(self.series.items())]
+
+
+def make_recorder(clock, **kw):
+    kw.setdefault("registry", FakeRegistry())
+    kw.setdefault("cadence_s", 30.0)
+    return FlightRecorder(clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+# trigger bus
+# ---------------------------------------------------------------------------
+
+class TestIncidentBus:
+    def test_disarmed_publish_is_a_noop(self):
+        assert not BUS.armed
+        assert publish_incident("circuit_open", {"x": 1}) is False
+        assert BUS.published == {} and BUS.suppressed == {}
+
+    def test_unregistered_kind_raises_when_armed(self):
+        BUS.arm(lambda k, d, t: None, Clock())
+        with pytest.raises(ValueError):
+            publish_incident("totally_new_kind")
+
+    def test_dedup_window_suppresses_then_reopens(self):
+        clk = Clock()
+        seen = []
+        BUS.arm(lambda k, d, t: seen.append((k, t)), clk, dedup_s=300.0)
+        assert publish_incident("watchdog_trip") is True
+        clk.t = 299.0
+        assert publish_incident("watchdog_trip") is False
+        clk.t = 299.5
+        # a different kind has its own window
+        assert publish_incident("fence_refusal") is True
+        clk.t = 301.0
+        assert publish_incident("watchdog_trip") is True
+        assert seen == [("watchdog_trip", 0.0), ("fence_refusal", 299.5),
+                        ("watchdog_trip", 301.0)]
+        assert BUS.published == {"watchdog_trip": 2, "fence_refusal": 1}
+        assert BUS.suppressed == {"watchdog_trip": 1}
+
+    def test_sink_exception_counted_never_raised(self):
+        def bad_sink(k, d, t):
+            raise RuntimeError("forensics exploded")
+        BUS.arm(bad_sink, Clock())
+        assert publish_incident("solver_demotion") is False
+        assert BUS.sink_errors == 1
+        # the trip itself was still counted as published (it cleared dedup)
+        assert BUS.published == {"solver_demotion": 1}
+
+    def test_suppressed_callback_exception_swallowed(self):
+        def bad_cb(kind, now):
+            raise RuntimeError("episode bookkeeping exploded")
+        BUS.arm(lambda k, d, t: None, Clock(), on_suppressed=bad_cb)
+        publish_incident("circuit_open")
+        assert publish_incident("circuit_open") is False  # no raise
+        assert BUS.suppressed == {"circuit_open": 1}
+
+    def test_concurrent_trigger_storm_one_bundle_per_kind(self, tmp_path):
+        """Many threads slam several kinds at one clock instant: exactly
+        one bundle per kind, every repeat counted as suppressed, and no
+        exception escapes into any publishing thread."""
+        clk = Clock(1000.0)
+        fr = make_recorder(clk, dirpath=str(tmp_path))
+        fr.arm()
+        kinds = ["circuit_open", "watchdog_trip", "solver_demotion",
+                 "fence_refusal"]
+        per_thread, n_threads = 50, 8
+        errors = []
+        start = threading.Barrier(n_threads)
+
+        def storm(i):
+            try:
+                start.wait()
+                for j in range(per_thread):
+                    publish_incident(kinds[(i + j) % len(kinds)], {"i": i})
+            except Exception as e:   # pragma: no cover - the assertion
+                errors.append(e)
+
+        threads = [threading.Thread(target=storm, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert sorted(b["kind"] for b in fr.bundles) == sorted(kinds)
+        assert BUS.published == {k: 1 for k in kinds}
+        total = n_threads * per_thread
+        assert sum(BUS.suppressed.values()) == total - len(kinds)
+        # the atomic writes all landed, one file per kind, no tmp litter
+        assert len(list_bundle_ids(str(tmp_path))) == len(kinds)
+        assert [n for n in os.listdir(str(tmp_path))
+                if n.endswith(".tmp")] == []
+
+
+# ---------------------------------------------------------------------------
+# metrics history ring
+# ---------------------------------------------------------------------------
+
+class TestMetricsRing:
+    def test_series_key_sorts_labels(self):
+        assert series_key("x_total", ()) == "x_total"
+        assert series_key("x_total", (("b", "2"), ("a", "1"))) == \
+            'x_total{a="1",b="2"}'
+
+    def test_cadence_bounded_and_capped(self):
+        clk = Clock()
+        reg = FakeRegistry()
+        reg.set("a_total", 0)
+        ring = MetricsRing(clk, cadence_s=30.0, slots=4)
+        assert ring.sample(reg) is True
+        clk.t = 10.0
+        assert ring.sample(reg) is False      # inside the cadence
+        for i in range(1, 10):
+            clk.t = 30.0 * i
+            assert ring.sample(reg) is True
+        assert len(ring) == 4                 # bounded deque
+        assert ring.samples_taken == 10
+
+    def test_deltas_baseline_at_or_before_window_start(self):
+        clk = Clock()
+        reg = FakeRegistry()
+        ring = MetricsRing(clk, cadence_s=30.0)
+        for t, a, b in [(0.0, 1.0, 5.0), (30.0, 3.0, 5.0), (60.0, 7.0, 5.0)]:
+            clk.t = t
+            reg.set("a_total", a)
+            reg.set("b_gauge", b)
+            ring.sample(reg)
+        # window [30, 70]: baseline is the newest sample at-or-before 30
+        d = ring.deltas(40.0, 70.0)
+        assert d["from_t"] == 30.0 and d["to_t"] == 60.0
+        assert d["changed"] == {"a_total": 4.0}   # b never moved: omitted
+        # window longer than history: baseline falls back to the oldest
+        d = ring.deltas(1000.0, 70.0)
+        assert d["from_t"] == 0.0
+        assert d["changed"] == {"a_total": 6.0}
+
+    def test_deltas_empty_ring(self):
+        ring = MetricsRing(Clock())
+        assert ring.deltas(600.0, 100.0) == \
+            {"from_t": None, "to_t": None, "changed": {}}
+
+
+# ---------------------------------------------------------------------------
+# bundle files
+# ---------------------------------------------------------------------------
+
+def _bundle(t=12.0, kind="circuit_open", seq=1, **extra):
+    b = {"id": bundle_id(t, kind, seq), "kind": kind, "t": t, "seq": seq,
+         "window": [t - 600.0, t], "detail": {}, "metrics": {}}
+    b.update(extra)
+    return b
+
+
+class TestBundleFiles:
+    def test_write_is_atomic_and_roundtrips(self, tmp_path):
+        b = _bundle(detail={"controller": "disruption"})
+        path = write_bundle(str(tmp_path), b)
+        assert os.path.basename(path) == f"incident-{b['id']}.json"
+        assert not os.path.exists(path + ".tmp")
+        assert read_bundle(str(tmp_path), b["id"]) == b
+
+    def test_corrupt_file_reads_as_stub_not_exception(self, tmp_path):
+        b = _bundle()
+        path = write_bundle(str(tmp_path), b)
+        # truncate mid-write, as the crash the recorder exists to explain
+        with open(path, "w") as fh:
+            fh.write('{"id": "0000000012000-circ')
+        doc = read_bundle(str(tmp_path), b["id"])
+        assert doc["corrupt"] is True and doc["id"] == b["id"]
+        # a well-formed file that isn't an object is corrupt too
+        with open(path, "w") as fh:
+            fh.write("[1, 2, 3]\n")
+        assert read_bundle(str(tmp_path), b["id"])["corrupt"] is True
+        # absent is None, not corrupt
+        assert read_bundle(str(tmp_path), "0000000099000-nope-0099") is None
+
+    def test_prune_drops_oldest_past_retention(self, tmp_path):
+        ids = []
+        for seq in range(1, 6):
+            b = _bundle(t=float(seq), seq=seq)
+            write_bundle(str(tmp_path), b)
+            ids.append(b["id"])
+        assert prune(str(tmp_path), 2) == ids[:3]
+        assert list_bundle_ids(str(tmp_path)) == ids[3:]
+
+    def test_incident_report_renders_corrupt_bundle(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "incident_report", os.path.join(REPO, "tools",
+                                            "incident_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        b = _bundle()
+        path = write_bundle(str(tmp_path), b)
+        with open(path, "w") as fh:
+            fh.write("not json at all")
+        doc = read_bundle(str(tmp_path), b["id"])
+        out = mod.render(doc)
+        assert "corrupt" in out.lower() and b["id"] in out
+
+
+# ---------------------------------------------------------------------------
+# recorder: capture, episodes, warm-restart carry
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_capture_assembles_context(self):
+        clk = Clock(700.0)
+        reg = FakeRegistry()
+        reg.set("trips_total", 1.0)
+        fr = make_recorder(clk, registry=reg)
+        fr.sample()
+        reg.set("trips_total", 4.0)
+        clk.t = 730.0
+        fr.sample()
+        fr.health_cb = lambda: {"phase": "DEGRADED"}
+        fr.chaos_cb = lambda: {"enabled": True}
+        fr.fence_cb = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        fr.provenance_cb = lambda pods: [{"pod": p} for p in pods]
+        fr.traces_cb = lambda: [{"name": f"t{i}"} for i in range(100)]
+        fr.arm()
+        assert publish_incident(
+            "parity_mismatch", {"pods": ["default/a"]}) is True
+        (b,) = fr.bundles
+        assert b["id"] == bundle_id(730.0, "parity_mismatch", 1)
+        assert b["window"] == [130.0, 730.0]
+        assert b["metrics"]["changed"] == {"trips_total": 3.0}
+        assert b["health"] == {"phase": "DEGRADED"}
+        assert b["chaos"] == {"enabled": True}
+        # a context callback that throws is captured as an error field,
+        # never raised into the tripping thread
+        assert "RuntimeError" in b["fencing"]["error"]
+        assert b["provenance"] == [{"pod": "default/a"}]
+        assert len(b["traces"]) == fr.trace_cap   # newest-first, capped
+
+    def test_suppressed_repeats_extend_the_episode(self):
+        clk = Clock()
+        fr = make_recorder(clk, dedup_s=300.0)
+        fr.arm()
+        publish_incident("leader_loss")
+        for t in (100.0, 200.0, 290.0):
+            clk.t = t
+            assert publish_incident("leader_loss") is False
+        (b,) = fr.bundles
+        assert b["window"][1] == 290.0 and b["repeats"] == 3
+        clk.t = 301.0     # dedup cleared: a second bundle opens
+        assert publish_incident("leader_loss") is True
+        assert [x["seq"] for x in fr.bundles] == [1, 2]
+        # consecutive episodes tile the fault interval (dedup < window)
+        assert fr.bundles[1]["window"][0] < b["window"][1] + fr.dedup_s
+
+    def test_memory_retention_bounds_the_deque(self):
+        clk = Clock()
+        fr = make_recorder(clk, retention=3, dedup_s=1.0)
+        fr.arm()
+        for i, kind in enumerate(sorted(INCIDENT_KINDS)[:5]):
+            clk.t = 10.0 * i
+            publish_incident(kind)
+        assert len(fr.bundles) == 3
+        assert [b["seq"] for b in fr.bundles] == [3, 4, 5]
+
+    def test_disk_write_failure_degrades_to_memory_only(self, tmp_path):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file where the bundle dir should go")
+        fr = make_recorder(Clock(), dirpath=str(blocker))
+        fr.arm()
+        assert publish_incident("snapshot_fallback") is True
+        assert len(fr.bundles) == 1 and fr.write_errors == 1
+        assert BUS.sink_errors == 0   # the failure never became an incident
+
+    def test_snapshot_restore_neither_replays_nor_forgets(self):
+        clk = Clock(500.0)
+        fr = make_recorder(clk, dedup_s=300.0)
+        fr.arm()
+        fr.sample()
+        publish_incident("circuit_open", {"controller": "disruption"})
+        state = fr.snapshot_state()
+        state = json.loads(json.dumps(state))   # as the snapshot file would
+        fr.disarm()
+
+        clk.t = 600.0   # restart lands inside the dedup window
+        fr2 = make_recorder(clk, dedup_s=300.0)
+        fr2.restore_state(state)
+        fr2.arm()
+        # the trip captured just before the restart is NOT re-captured...
+        assert publish_incident("circuit_open") is False
+        assert len(fr2.bundles) == 0
+        # ...but not forgotten either: the carried summary still lists it
+        s = fr2.summary()
+        assert s["by_kind"] == {"circuit_open": 1}
+        assert s["bundles"][0]["id"] == bundle_id(500.0, "circuit_open", 1)
+        assert s["suppressed"] == {"circuit_open": 1}
+        # ring cursor carried across the restart
+        assert fr2.ring.samples_taken == 1
+        assert fr2.ring.snapshot_state()["last_t"] == 500.0
+        # past the window the next capture continues the sequence
+        clk.t = 900.0
+        assert publish_incident("circuit_open") is True
+        assert fr2.bundles[0]["seq"] == 2
+        assert fr2.summary()["by_kind"] == {"circuit_open": 2}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end sim captures
+# ---------------------------------------------------------------------------
+
+def _coverage(bundles, lo, hi):
+    """Fraction of [lo, hi] covered by the union of bundle windows."""
+    spans = sorted((max(lo, b["window"][0]), min(hi, b["window"][1]))
+                   for b in bundles)
+    covered, cursor = 0.0, lo
+    for a, z in spans:
+        a = max(a, cursor)
+        if z > a:
+            covered += z - a
+            cursor = z
+    return covered / (hi - lo)
+
+
+def test_chaos_storm_gate_on_bundles_per_fault_family():
+    """FlightRecorder ON over the chaos storm: every injected fault
+    family surfaces as at least one bundle — the disruption crash-loop
+    as `circuit_open`, the pack-rung errors as `solver_demotion` (the
+    create_fleet storm is absorbed by the paced provisioning circuit,
+    which is itself a circuit_open trip) — and dedup keeps a storm that
+    trips every tick down to a handful of bundles, not a flood."""
+    sc = load_scenario(os.path.join(SCENARIOS, "chaos-storm.yaml"))
+    run = SimHarness(sc, seed=0, duration_s=5400.0,
+                     flight_recorder=True).run()
+    rep = json.loads(report_to_json(run.report))
+    inc = rep["incidents"]
+    assert inc["by_kind"].get("circuit_open", 0) >= 1
+    assert inc["by_kind"].get("solver_demotion", 0) >= 1
+    assert len(inc["bundles"]) <= 12          # dedup: no bundle flood
+    assert inc["sink_errors"] == 0
+    assert inc["ring"]["entries"] > 0
+    # no trip is lost: every publish the bus counted became a bundle
+    assert inc["published"] == inc["by_kind"]
+    # every bundle carries its full lookback window of history, and the
+    # first quarantine's lookback reaches the crash-loop onset (600s in)
+    t0 = sc.start_s
+    for b in inc["bundles"]:
+        assert b["window"][1] - b["window"][0] >= 600.0
+    circ = [b for b in inc["bundles"] if b["kind"] == "circuit_open"]
+    assert min(b["window"][0] for b in circ) <= t0 + 600.0
+    # the two pack-rung demotions land close enough that their windows
+    # tile (dedup < window): one contiguous forensic record of the fault
+    sol = sorted(b["window"] for b in inc["bundles"]
+                 if b["kind"] == "solver_demotion")
+    for (a1, z1), (a2, z2) in zip(sol, sol[1:]):
+        assert a2 <= z1
+
+
+def test_failover_drill_gate_on_leader_loss_coverage():
+    """FlightRecorder ON over the failover drill: the 10-minute lease
+    blackout (rate 1.0 over [1200, 1800]) publishes `leader_loss` on
+    every errored acquire — thousands of trips — and the recorder folds
+    them into a couple of episodes whose windows cover >=95% of the
+    blackout, with every repeat counted as suppressed."""
+    sc = load_scenario(os.path.join(SCENARIOS, "failover-drill.yaml"))
+    run = SimHarness(sc, seed=0, duration_s=5400.0,
+                     flight_recorder=True).run()
+    rep = json.loads(report_to_json(run.report))
+    inc = rep["incidents"]
+    losses = [b for b in inc["bundles"] if b["kind"] == "leader_loss"]
+    assert 1 <= len(losses) <= 6              # episodes, not a flood
+    assert inc["suppressed"].get("leader_loss", 0) > 1000
+    t0 = sc.start_s
+    assert _coverage(losses, t0 + 1200.0, t0 + 1800.0) >= 0.95
+
+
+GOLDEN_CASES = [
+    ("diurnal", "diurnal.yaml", 7200.0),
+    ("spot-reclaim-storm", "spot-reclaim-storm.yaml", 7200.0),
+    ("ice-starvation", "ice-starvation.yaml", 5400.0),
+    ("diurnal-forecast", "diurnal-forecast.yaml", 7200.0),
+    ("spot-reclaim-storm-forecast", "spot-reclaim-storm-forecast.yaml",
+     7200.0),
+    ("steady-state-drip", "steady-state-drip.yaml", 300.0),
+    ("chaos-storm", "chaos-storm.yaml", 5400.0),
+    ("long-soak", "long-soak.yaml", 120.0),
+    ("failover-drill", "failover-drill.yaml", 5400.0),
+]
+
+
+@pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
+                         ids=[c[0] for c in GOLDEN_CASES])
+def test_golden_report_flight_recorder_gate_off(name, fname, duration):
+    """FlightRecorder defaults OFF and, explicitly off, must leave every
+    canned scenario's report byte-identical — the disarmed bus is one
+    boolean check and the recorder is never constructed."""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     flight_recorder=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"flight_recorder=off report for {fname} diverged from {path}: "
+            f"the recorder perturbed a run it never armed for")

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Pretty-print incident flight-recorder forensic bundles.
+
+One report per bundle: what tripped, when, the metric series that moved
+over the preceding window, the trace ring at capture, and the health /
+chaos / fencing / provenance context — the post-mortem in one page
+(docs/observability.md).
+
+Sources, auto-detected from the argument:
+
+    python tools/incident_report.py http://127.0.0.1:8080      # live operator
+    python tools/incident_report.py /var/lib/karpenter/incidents   # --incident-dir
+    python tools/incident_report.py incident-....json          # one bundle file
+
+Default is the NEWEST bundle; `--list` shows the index, `--id` picks one,
+`--deltas N` bounds the metric-delta table (default 20).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+
+def _fetch(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _load_http(base: str, bundle_id):
+    index = _fetch(base.rstrip("/") + "/debug/incidents")
+    bundles = index.get("bundles", [])
+    if bundle_id is None and bundles:
+        bundle_id = bundles[-1]["id"]
+    bundle = _fetch(base.rstrip("/") + "/debug/incidents/" + bundle_id) \
+        if bundle_id else None
+    return index, bundle
+
+
+def _load_dir(path: str, bundle_id):
+    names = sorted(n for n in os.listdir(path)
+                   if n.startswith("incident-") and n.endswith(".json"))
+    ids = [n[len("incident-"):-len(".json")] for n in names]
+    index = {"bundles": [{"id": i} for i in ids]}
+    if bundle_id is None and ids:
+        bundle_id = ids[-1]
+    bundle = None
+    if bundle_id is not None:
+        with open(os.path.join(path, f"incident-{bundle_id}.json"),
+                  encoding="utf-8") as fh:
+            bundle = json.load(fh)
+    return index, bundle
+
+
+def _span_line(span, depth=0):
+    lines = [f"{'  ' * depth}{span['name']:<{max(34 - 2 * depth, 1)}} "
+             f"{span['duration_ms']:9.2f}ms"]
+    for child in span.get("children", []):
+        lines.extend(_span_line(child, depth + 1))
+    return lines
+
+
+def render(bundle, max_deltas: int = 20) -> str:
+    if bundle.get("corrupt"):
+        return (f"bundle {bundle.get('id')}: CORRUPT on disk "
+                f"({bundle.get('error')}) — partial write or bit rot; "
+                "the in-memory copy (if the process is up) is intact")
+    w = bundle.get("window", [None, None])
+    out = [
+        f"incident {bundle['id']}",
+        f"  kind:     {bundle['kind']}",
+        f"  tripped:  t={bundle.get('t')}  window=[{w[0]}, {w[1]}]"
+        + (f"  repeats={bundle['repeats']}" if bundle.get("repeats") else ""),
+        f"  detail:   {json.dumps(bundle.get('detail', {}), sort_keys=True)}",
+    ]
+    deltas = (bundle.get("metrics") or {}).get("changed", {})
+    out.append(f"  metric deltas over the window ({len(deltas)} series"
+               + (f", top {max_deltas}" if len(deltas) > max_deltas else "")
+               + "):")
+    ranked = sorted(deltas.items(), key=lambda kv: (-abs(kv[1]), kv[0]))
+    for key, d in ranked[:max_deltas]:
+        out.append(f"    {key:<64} {d:+g}")
+    traces = bundle.get("traces") or []
+    out.append(f"  traces at capture ({len(traces)}, newest first):")
+    for t in traces[:5]:
+        out.extend("    " + ln for ln in _span_line(t))
+    if len(traces) > 5:
+        out.append(f"    … {len(traces) - 5} more")
+    for section in ("health", "chaos", "fencing"):
+        data = bundle.get(section)
+        if data is not None:
+            doc = json.dumps(data, sort_keys=True, default=str)[:400]
+            out.append(f"  {section}: {doc}")
+    prov = bundle.get("provenance") or []
+    if prov:
+        out.append(f"  provenance ({len(prov)} pod record(s)):")
+        for rec in prov[:5]:
+            out.append("    " +
+                       json.dumps(rec, sort_keys=True, default=str)[:200])
+    sup = bundle.get("suppressed") or {}
+    if sup:
+        out.append("  suppressed since arm: " +
+                   json.dumps(sup, sort_keys=True))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Pretty-print incident flight-recorder bundles")
+    p.add_argument("source", help="operator base URL (http://host:port), "
+                                  "an --incident-dir directory, or one "
+                                  "bundle JSON file")
+    p.add_argument("--id", default=None, help="bundle id (default: newest)")
+    p.add_argument("--list", action="store_true",
+                   help="list the bundle index and exit")
+    p.add_argument("--deltas", type=int, default=20,
+                   help="max metric-delta rows (default 20)")
+    args = p.parse_args(argv)
+
+    if args.source.startswith(("http://", "https://")):
+        index, bundle = _load_http(args.source, args.id)
+    elif os.path.isdir(args.source):
+        index, bundle = _load_dir(args.source, args.id)
+    else:
+        with open(args.source, encoding="utf-8") as fh:
+            index, bundle = None, json.load(fh)
+
+    if args.list:
+        entries = (index or {}).get("bundles", [])
+        print(f"{len(entries)} bundle(s), oldest first:")
+        for e in entries:
+            extra = f"  kind={e['kind']}  t={e['t']}" if "kind" in e else ""
+            print(f"  {e['id']}{extra}")
+        return 0
+    if bundle is None:
+        print("no bundles captured", file=sys.stderr)
+        return 1
+    print(render(bundle, max_deltas=args.deltas))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
