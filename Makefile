@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke incident-smoke deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode bench-lp decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke incident-smoke deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -39,6 +39,9 @@ bench-megafleet: ## 1M-pod partitioned solve: weak-scaling 1→8 shards + full-d
 
 bench-decode: ## Host-vs-device plan-assembly A/B at 2/4/8 shards, exact plan parity enforced (one JSON line)
 	python bench.py --decode
+
+bench-lp: ## Device-PDHG vs HiGHS A/B on refinery masters + vmapped pricing sweeps (one JSON line)
+	python bench.py --lp
 
 decode-smoke: ## Truncated decode A/B gate (16k pods) + the decode parity/breaker suite (docs/performance.md)
 	JAX_PLATFORMS=cpu KARPENTER_TPU_MEGAFLEET_UNIT=2000 python bench.py --decode

@@ -1044,6 +1044,277 @@ def run_decode_ab(shard_counts=(2, 4, 8), iters=3):
     return tail
 
 
+def _lp_instance(n_classes, n_types, rng):
+    """One refinery-shaped LP workload: blended pods tensorized against a
+    generated catalog, deduped to LP-distinguishable options — exactly
+    the operands `solve_guided` hands to the refine path."""
+    from karpenter_tpu.api.objects import NodePool
+    from karpenter_tpu.catalog.generate import generate_catalog
+    from karpenter_tpu.ops import lpguide
+    from karpenter_tpu.ops.tensorize import tensorize
+
+    pods = build_pods(n_classes, n_classes * 20, rng, zone_frac=0.2)
+    prob = tensorize(pods, generate_catalog(n_types), [NodePool()])
+    ok = lpguide._feasible_mask(prob)
+    alloc, price, compat, _ = lpguide._dedup_with_inverse(
+        prob.option_alloc.astype(np.float64),
+        prob.option_price.astype(np.float64), ok)
+    req = prob.class_requests.astype(np.float64)
+    cnt = prob.class_counts.astype(np.float64)
+    return req, cnt, compat, alloc, price
+
+
+def _lp_master_operands(req, cnt, compat, alloc, price, support):
+    """Restricted-master operands over a FIXED colgen support, built both
+    ways: scipy-sparse for the HiGHS side and the active-option dense
+    block the device path solves (mirroring one `exact_lp_mix` round).
+    Fixing the support makes the A/B a solver comparison, not a
+    column-generation-trajectory comparison."""
+    from scipy import sparse
+
+    C, R = req.shape
+    O = alloc.shape[0]
+    S = np.zeros(O, bool)
+    S[np.asarray(support, np.int64)] = True
+    pc, pj = np.nonzero(compat & S[None, :])
+    P = len(pc)
+    act = np.unique(pj)
+    Oa = len(act)
+    newj = np.full(O, -1, np.int64)
+    newj[act] = np.arange(Oa)
+    A_ub = np.zeros((Oa * R, P + Oa))
+    rows = newj[pj][:, None] * R + np.arange(R)[None, :]
+    A_ub[rows.ravel(),
+         np.broadcast_to(np.arange(P)[:, None], (P, R)).ravel()] = \
+        req[pc].ravel()
+    A_ub[np.arange(Oa * R), np.arange(Oa).repeat(R) + P] = \
+        -alloc[act].reshape(-1)
+    A_eq = np.zeros((C, P + Oa))
+    A_eq[pc, np.arange(P)] = 1.0
+    c_obj = np.concatenate([np.zeros(P), price[act]])
+    sp_ub = sparse.csr_matrix(A_ub)
+    sp_eq = sparse.csr_matrix(A_eq)
+    return dict(c=c_obj, A_ub=A_ub, b_ub=np.zeros(Oa * R), A_eq=A_eq,
+                b_eq=cnt, sp_ub=sp_ub, sp_eq=sp_eq, P=P, Oa=Oa, R=R)
+
+
+def _lp_pricing_jobs(req, cnt, compat, alloc, duals):
+    """The ggbound pricing sweep for one dual vector: per candidate
+    option j, max Σ duals·z s.t. req·z ≤ alloc_j, 0 ≤ z ≤ per-class fit
+    caps — the LPs `_device_screen` batches and HiGHS solves serially."""
+    jobs = []
+    for j in range(alloc.shape[0]):
+        idx = np.nonzero(compat[:, j] & (duals > 1e-9))[0]
+        if len(idx) == 0:
+            continue
+        reqpos = req[idx] > 0
+        safe = np.where(reqpos, req[idx], 1.0)
+        ubj = np.where(reqpos, alloc[j][None, :] // safe, np.inf).min(axis=1)
+        jobs.append((j, idx, ubj))
+    return jobs
+
+
+def run_lp_ab(sizes=(100, 250, 500), iters=5, n_types=40,
+              iters_cap=12000):
+    """`make bench-lp`: the device-PDHG vs HiGHS A/B over refinery LPs
+    (the TPU-native batched LP tentpole).
+
+    Two measurements per class count, both against the SAME operands:
+
+      * restricted master (single LP): the colgen support is fixed by an
+        off-clock HiGHS refine, then the restricted master is re-solved
+        both ways — HiGHS p50/p95 vs device cold (jit + first solve) and
+        device warm-started p50/p95 (the steady-state tick-to-tick
+        refine, where the previous terminal iterate seeds the next
+        solve).  Objective parity within the certified tolerance is
+        asserted before any device timing counts; a capped (non-
+        converged) device solve voids that size's device row instead —
+        exactly the outcome the SolverHealth ladder demotes on.
+
+      * pricing sweep (vmapped batch): every candidate option's pricing
+        LP under the master's duals, serially through HiGHS (the ggbound
+        baseline) vs ONE `solve_lp_batch` dispatch, cold and warm.
+
+    The iteration cap sits below the solver default, and quarters again
+    once the padded envelope crosses 4096 columns (a CPU iteration at
+    8192 wide costs ~25 ms), so a non-converging size costs bounded wall
+    clock, not 20k iterations."""
+    from scipy.optimize import linprog
+
+    from karpenter_tpu.ops import lpguide, lpsolve
+    from karpenter_tpu.utils import metrics
+
+    lp_solves = metrics.lp_solves()
+    rng = np.random.default_rng(42)
+    curve = []
+    for C in sizes:
+        req, cnt, compat, alloc, price = _lp_instance(C, n_types, rng)
+        # off-clock HiGHS refine fixes the support and the reference
+        # objective; its latency is the production baseline refine
+        t_ref = []
+        for _ in range(iters):
+            gc.collect()
+            gc.disable()
+            t0 = time.perf_counter()
+            try:
+                x_ref, z_ref, info = lpguide.exact_lp_mix(
+                    req, cnt, compat, alloc, price)
+            finally:
+                gc.enable()
+            t_ref.append((time.perf_counter() - t0) * 1000.0)
+        ops = _lp_master_operands(req, cnt, compat, alloc, price,
+                                  info["support"])
+        n = ops["P"] + ops["Oa"]
+        from karpenter_tpu.ops.tensorize import pad_to
+        cap = iters_cap if pad_to(n, lpsolve.LP_BUCKETS) <= 4096 \
+            else iters_cap // 4
+
+        def solve_highs():
+            return linprog(ops["c"], A_ub=ops["sp_ub"], b_ub=ops["b_ub"],
+                           A_eq=ops["sp_eq"], b_eq=ops["b_eq"],
+                           bounds=(0, None), method="highs")
+
+        def solve_device():
+            return lpsolve.solve_lp(
+                ops["c"], A_eq=ops["A_eq"], b_eq=ops["b_eq"],
+                A_ub=ops["A_ub"], b_ub=ops["b_ub"],
+                warm_key=f"bench:lp:master:{C}", iters_cap=cap)
+
+        lpsolve.reset_caches()
+        res_h = solve_highs()
+        assert res_h.status == 0, f"HiGHS failed the C={C} master"
+        gc.collect()
+        gc.disable()
+        t0 = time.perf_counter()
+        try:
+            sol_cold = solve_device()
+        finally:
+            gc.enable()
+        cold_ms = (time.perf_counter() - t0) * 1000.0
+
+        times = {"highs": [], "device": []}
+        parity = None
+        if sol_cold.converged:
+            parity = abs(sol_cold.obj - res_h.fun) / max(1.0, abs(res_h.fun))
+            assert parity < 1e-3, \
+                f"device master diverged from HiGHS at C={C}: {parity:.2e}"
+            for _ in range(iters):
+                # interleaved so machine-load drift lands on both sides
+                for side, fn in (("highs", solve_highs),
+                                 ("device", solve_device)):
+                    before = lp_solves.value({"outcome": "converged"})
+                    gc.collect()
+                    gc.disable()
+                    t0 = time.perf_counter()
+                    try:
+                        out = fn()
+                    finally:
+                        gc.enable()
+                    times[side].append((time.perf_counter() - t0) * 1000.0)
+                    if side == "device":
+                        assert out.converged, "warm device solve regressed"
+                        assert lp_solves.value(
+                            {"outcome": "converged"}) == before + 1, \
+                            "device solve did not engage"
+
+        # ---- pricing sweep: serial HiGHS vs one vmapped batch ----
+        duals = np.asarray(res_h.eqlin.marginals, np.float64)
+        jobs = _lp_pricing_jobs(req, cnt, compat, alloc, duals)
+        t0 = time.perf_counter()
+        hvals = {}
+        for j, idx, ubj in jobs:
+            r = linprog(-duals[idx], A_ub=req[idx].T, b_ub=alloc[j],
+                        bounds=[(0, u) for u in ubj], method="highs")
+            hvals[j] = -r.fun
+        serial_ms = (time.perf_counter() - t0) * 1000.0
+
+        def solve_batch():
+            insts = [lpsolve.LPInstance(
+                c=-duals[idx], A_ub=req[idx].T, b_ub=alloc[j], upper=ubj,
+                warm_key=f"bench:lp:pricing:{C}:{j}")
+                for j, idx, ubj in jobs]
+            return lpsolve.solve_lp_batch(insts)
+        gc.collect()
+        t0 = time.perf_counter()
+        sols = solve_batch()
+        batch_cold_ms = (time.perf_counter() - t0) * 1000.0
+        t0 = time.perf_counter()
+        sols = solve_batch()
+        batch_warm_ms = (time.perf_counter() - t0) * 1000.0
+        # certified screen values must dominate the serial-HiGHS optima
+        # (weak duality) — validity holds even for capped members
+        ub_slack = max(
+            lpsolve.certified_upper_bound(duals[idx], req[idx].T, alloc[j],
+                                          ubj, s.lam) - hvals[j]
+            for (j, idx, ubj), s in zip(jobs, sols))
+        assert ub_slack > -1e-6, \
+            f"certified pricing bound fell below HiGHS optimum at C={C}"
+
+        entry = {
+            "classes": C, "master_n": n, "options": int(alloc.shape[0]),
+            "refine_highs_p50_ms": round(float(np.percentile(t_ref, 50)), 1),
+            "refine_highs_p95_ms": round(float(np.percentile(t_ref, 95)), 1),
+            "master_highs_p50_ms":
+                round(float(np.percentile(times["highs"], 50)), 2)
+                if times["highs"] else None,
+            "master_highs_p95_ms":
+                round(float(np.percentile(times["highs"], 95)), 2)
+                if times["highs"] else None,
+            "master_device_cold_ms": round(cold_ms, 1),
+            "master_device_warm_p50_ms":
+                round(float(np.percentile(times["device"], 50)), 2)
+                if times["device"] else None,
+            "master_device_warm_p95_ms":
+                round(float(np.percentile(times["device"], 95)), 2)
+                if times["device"] else None,
+            "master_device_status": sol_cold.status,
+            "master_device_iterations": sol_cold.iterations,
+            "master_parity_rel": None if parity is None
+                else round(parity, 8),
+            "pricing_batch": len(jobs),
+            "pricing_serial_highs_ms": round(serial_ms, 1),
+            "pricing_device_cold_ms": round(batch_cold_ms, 1),
+            "pricing_device_warm_ms": round(batch_warm_ms, 1),
+            "pricing_converged": sum(s.converged for s in sols),
+        }
+        entry["master_speedup"] = round(
+            entry["master_highs_p50_ms"] / entry["master_device_warm_p50_ms"],
+            3) if entry["master_device_warm_p50_ms"] else None
+        entry["pricing_speedup_warm"] = round(
+            serial_ms / batch_warm_ms, 3) if batch_warm_ms else None
+        curve.append(entry)
+        log(f"[lp-ab-{C}] master n={n} highs={entry['master_highs_p50_ms']}ms "
+            f"device cold={entry['master_device_cold_ms']}ms "
+            f"warm={entry['master_device_warm_p50_ms']}ms "
+            f"({entry['master_device_status']}) "
+            f"parity={entry['master_parity_rel']} | pricing "
+            f"B={len(jobs)} serial={entry['pricing_serial_highs_ms']}ms "
+            f"batch warm={entry['pricing_device_warm_ms']}ms "
+            f"({entry['pricing_speedup_warm']}x)")
+
+    # headline: the largest size whose device master converged
+    top = next((e for e in reversed(curve)
+                if e["master_device_warm_p50_ms"] is not None), curve[-1])
+    warm = top.get("master_device_warm_p50_ms")
+    tail = {
+        "metric": f"{top['classes']}-class restricted-master refine p50, "
+                  f"warm device PDHG (HiGHS A/B, fixed support)",
+        "value": warm,
+        "unit": "ms",
+        # acceptance: device refine p50 10x under HiGHS → vs_baseline >= 1
+        "vs_baseline": round(
+            top["master_highs_p50_ms"] / warm / 10.0, 4)
+        if warm and top.get("master_highs_p50_ms") else None,
+        "lp_master_device_warm_p50_ms": warm,
+        "lp_master_highs_p50_ms": top.get("master_highs_p50_ms"),
+        "lp_pricing_speedup_warm": top.get("pricing_speedup_warm"),
+        "lp_ab": curve,
+        "lp_sizes": [e["classes"] for e in curve],
+        "host_cores": os.cpu_count(),
+    }
+    return tail
+
+
 def _backend_fields(platform):
     """Backend provenance for every JSON tail: what the orchestrator asked
     for (`auto` = subprocess discovery), what the child actually ran on,
@@ -1118,7 +1389,7 @@ def _run_child(env, timeout=3000):
     bench = os.path.abspath(__file__)
     args = [sys.executable, bench, "--run"]
     for flag in ("--smoke", "--consolidation", "--sim", "--forecast",
-                 "--drip", "--megafleet", "--soak", "--decode"):
+                 "--drip", "--megafleet", "--soak", "--decode", "--lp"):
         if flag in sys.argv[1:]:
             args.append(flag)
     try:
@@ -1169,7 +1440,8 @@ def main():
 
 
 def run_all(smoke=False, consolidation=False, sim=False, forecast=False,
-            drip=False, megafleet=False, soak=False, decode_ab=False):
+            drip=False, megafleet=False, soak=False, decode_ab=False,
+            lp_ab=False):
     import jax
     log("devices:", jax.devices())
     platform = jax.devices()[0].platform
@@ -1200,6 +1472,12 @@ def run_all(smoke=False, consolidation=False, sim=False, forecast=False,
         # `make bench-decode`: host-vs-device plan assembly A/B across
         # shard widths, exact plan parity enforced before any timing counts
         _emit(run_decode_ab(), platform)
+        return
+
+    if lp_ab:
+        # `make bench-lp`: device-PDHG vs HiGHS over refinery masters and
+        # vmapped pricing sweeps, objective parity enforced before timings
+        _emit(run_lp_ab(), platform)
         return
 
     if megafleet:
@@ -1363,6 +1641,7 @@ if __name__ == "__main__":
                 drip="--drip" in sys.argv[1:],
                 megafleet="--megafleet" in sys.argv[1:],
                 soak="--soak" in sys.argv[1:],
-                decode_ab="--decode" in sys.argv[1:])
+                decode_ab="--decode" in sys.argv[1:],
+                lp_ab="--lp" in sys.argv[1:])
     else:
         main()

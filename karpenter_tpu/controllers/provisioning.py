@@ -156,7 +156,9 @@ class Provisioner:
                  health=None,
                  watchdog_timeout_s: float = 0.0,
                  device_decode: bool = False,
-                 decode_health=None):
+                 decode_health=None,
+                 device_lp: bool = False,
+                 lp_health=None):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
@@ -188,6 +190,12 @@ class Provisioner:
         # for the one-shot early re-solve.
         self.lp_guide = lp_guide
         self.refinery = refinery if lp_guide else None
+        # DeviceLP feature gate: guide misses refine synchronously on the
+        # batched PDHG solver (ops/lpsolve.py) with lp_health as the
+        # device_lp→highs degradation ladder — the refined mix lands in
+        # the SAME tick instead of greedy-now-refined-next-tick.
+        self.device_lp = bool(device_lp) and lp_guide
+        self.lp_health = lp_health if self.device_lp else None
         if not lp_guide:
             self._classpack = functools.partial(solve_classpack, guide=None)
         elif self.refinery is not None:
@@ -195,6 +203,9 @@ class Provisioner:
                                                 refinery=self.refinery)
         else:
             self._classpack = solve_classpack
+        if self.device_lp:
+            self._classpack = functools.partial(
+                self._classpack, device_lp=True, lp_health=self.lp_health)
         # DeviceDecode feature gate: kernel emits the slot-sorted slab and
         # the host assembles plans/NodeClaims columnar-wise (ops/decode.py).
         # The DecodeHealth breaker demotes a failing slab path back to host

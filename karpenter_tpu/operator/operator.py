@@ -347,12 +347,24 @@ def build_controllers(op: Operator) -> Dict[str, object]:
     With both LPGuide and LPRefinery gates on, the provisioner gets a
     GuideRefinery so cold guide solves never block the tick — the colgen
     LP refines in a background worker and upgrades the next tick."""
+    # DeviceLP gate: its own two-rung degradation ladder
+    # (device_lp ──▶ highs, ops/health.lp_ladder) on the operator's
+    # injected clock; non-convergence or certificate failure demotes the
+    # guide's restricted masters to the HiGHS path for a backoff window,
+    # publishing solver_demotion like every ladder move.  It is
+    # snapshot-registered (state/snapshot.py section "lp_health").
+    device_lp = op.options.gate("LPGuide") and op.options.gate("DeviceLP")
+    lp_health = None
+    if device_lp:
+        from ..ops.health import lp_ladder
+        lp_health = lp_ladder(clock=op.clock)
     refinery = None
     if op.options.gate("LPGuide") and op.options.gate("LPRefinery"):
         from ..ops.refinery import GuideRefinery
         # both clocks ride the operator's injected clock: staleness AND
         # drain deadlines follow virtual time under the simulator
-        refinery = GuideRefinery(clock=op.clock, monotonic=op.clock)
+        refinery = GuideRefinery(clock=op.clock, monotonic=op.clock,
+                                 device_lp=device_lp, lp_health=lp_health)
     # ONE degradation ladder shared by provisioning and disruption: a rung
     # that times out in either solver demotes for both, so the whole tick
     # loop falls to the same guaranteed-terminating floor together
@@ -376,7 +388,9 @@ def build_controllers(op: Operator) -> Dict[str, object]:
         health=health,
         watchdog_timeout_s=solve_timeout,
         device_decode=op.options.gate("DeviceDecode"),
-        decode_health=decode_health)
+        decode_health=decode_health,
+        device_lp=device_lp,
+        lp_health=lp_health)
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {

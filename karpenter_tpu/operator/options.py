@@ -91,6 +91,13 @@ class Options:
     # bit-identical; a slab failure falls back to host assembly with a
     # counted outcome under a DecodeHealth breaker (docs/performance.md
     # "decode latency").
+    # DeviceLP: solve the LP guide's restricted masters on the batched
+    # PDHG solver (ops/lpsolve.py) so a cold mix-cache miss refines
+    # IN the same tick instead of shipping greedy and waiting for a
+    # background HiGHS refine — off by default; enable with --device-lp
+    # or --feature-gates DeviceLP=true.  Non-convergence demotes to the
+    # HiGHS rung under the lp_ladder (ops/health.py) with a
+    # solver_demotion incident; requires LPGuide.
     # HAFailover: fenced leadership + readiness-gated promotion
     # (utils/fencing.py, docs/robustness.md "HA failover") — the lease
     # carries a monotone fencing epoch; snapshot writes and cloud
@@ -114,6 +121,7 @@ class Options:
                                  "WarmRestart": False,
                                  "IngestBatch": False,
                                  "DeviceDecode": False,
+                                 "DeviceLP": False,
                                  "HAFailover": False,
                                  "FlightRecorder": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
@@ -244,6 +252,12 @@ class Options:
                             "slab with columnar NumPy instead of the "
                             "per-pod host loop (shorthand for "
                             "--feature-gates DeviceDecode=true)")
+        p.add_argument("--device-lp", action="store_true",
+                       default=False,
+                       help="solve the LP guide's restricted masters on "
+                            "the batched device PDHG solver so guide "
+                            "misses refine within the tick (shorthand "
+                            "for --feature-gates DeviceLP=true)")
         p.add_argument("--supervisor-circuit-threshold", type=int,
                        default=env.get("supervisor_circuit_threshold", 5),
                        help="consecutive reconcile errors before a "
@@ -411,6 +425,8 @@ class Options:
             opts.feature_gates["ShardedSolve"] = True
         if ns.device_decode:
             opts.feature_gates["DeviceDecode"] = True
+        if ns.device_lp:
+            opts.feature_gates["DeviceLP"] = True
         if ns.warm_restart:
             opts.feature_gates["WarmRestart"] = True
         if ns.ingest_batch:

@@ -609,7 +609,9 @@ def solve_classpack(problem: Problem,
                     guide: Optional[str] = "lp",
                     refinery=None,
                     device_decode: bool = False,
-                    decode_health=None) -> PackingResult:
+                    decode_health=None,
+                    device_lp: bool = False,
+                    lp_health=None) -> PackingResult:
     """Host wrapper: sort classes → pad → kernel → decode.
 
     device_decode=True (the `DeviceDecode` gate) routes batches at or
@@ -647,7 +649,8 @@ def solve_classpack(problem: Problem,
     if guide == "lp" and E == 0 and decode:
         from .lpguide import solve_guided
         res = solve_guided(problem, max_alternatives=max_alternatives,
-                           max_nodes=max_nodes, refinery=refinery)
+                           max_nodes=max_nodes, refinery=refinery,
+                           device_lp=device_lp, lp_health=lp_health)
         if res is not None:
             return res
     requests, counts, compat, caps, order = _sorted_classes(problem, ec)

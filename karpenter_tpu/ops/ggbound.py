@@ -32,23 +32,32 @@ from . import lpbound
 
 def gg_bound(problem, iters: int = 20, time_limit_s: float = 600.0,
              pricing_time_limit_s: float = 2.0,
-             warm_plan=None, log=None) -> Tuple[float, dict]:
+             warm_plan=None, log=None,
+             device: bool = False) -> Tuple[float, dict]:
     """Certified lower bound via column generation with Farley's rule.
 
     Returns (bound, info).  The bound is always valid: it starts at the
     exact class-LP optimum and only improves when an iteration's Farley
     value (or the converged master) exceeds it.  `warm_plan` may be a
     PackingResult whose node fills seed the column pool.
+
+    With `device=True` the per-option fractional pricing screens — the
+    bulk of the serial HiGHS calls — run as ONE vmapped PDHG batch
+    (ops/lpsolve.py), and the screen threshold uses the dual-certified
+    upper bound, which is valid for Farley regardless of PDHG
+    convergence (see `lpsolve.certified_upper_bound`).
     """
     best, _state, info = _colgen(problem, iters, time_limit_s,
-                                 pricing_time_limit_s, warm_plan, log)
+                                 pricing_time_limit_s, warm_plan, log,
+                                 device=device)
     return best, info
 
 
 def integral_bracket(problem, iters: int = 20, time_limit_s: float = 600.0,
                      pricing_time_limit_s: float = 2.0,
                      master_time_limit_s: float = 120.0,
-                     warm_plan=None, log=None) -> Tuple[float, float, dict]:
+                     warm_plan=None, log=None,
+                     device: bool = False) -> Tuple[float, float, dict]:
     """Bracket the EXACT integral packing optimum: (lb, ub, info).
 
     lb is the certified configuration-LP/Farley bound from column
@@ -66,7 +75,8 @@ def integral_bracket(problem, iters: int = 20, time_limit_s: float = 600.0,
     regardless of convergence, so (lb, ub) is always a valid bracket.
     """
     best, state, info = _colgen(problem, iters, time_limit_s,
-                                pricing_time_limit_s, warm_plan, log)
+                                pricing_time_limit_s, warm_plan, log,
+                                device=device)
     if state is None:
         return best, float("inf"), info
     ub, lam = _integral_master(state, master_time_limit_s)
@@ -96,8 +106,28 @@ def _integral_master(state, time_limit_s: float):
     return float(res.fun), np.round(res.x)
 
 
+def _device_screen(jobs, duals, req, alloc):
+    """Batched PDHG pre-screen: one vmapped solve over every option's
+    fractional pricing LP, then a dual-certified upper bound per option.
+
+    The certified bound (weak duality from the harvested λ ≥ 0) OVER-
+    estimates the pricing optimum even when PDHG did not converge, which
+    is exactly the direction both the screen and Farley's `worst`
+    quotient need — a loose bound only makes the screen conservative,
+    never invalid.  Returns {option j: certified ub}."""
+    from . import lpsolve
+    insts = [lpsolve.LPInstance(c=-duals[idx], A_ub=req[idx].T,
+                                b_ub=alloc[j], upper=ub,
+                                warm_key=f"gg:pricing:{j}")
+             for j, idx, ub in jobs]
+    sols = lpsolve.solve_lp_batch(insts)
+    return {j: lpsolve.certified_upper_bound(duals[idx], req[idx].T,
+                                             alloc[j], ub, sol.lam)
+            for (j, idx, ub), sol in zip(jobs, sols)}
+
+
 def _colgen(problem, iters, time_limit_s, pricing_time_limit_s,
-            warm_plan, log):
+            warm_plan, log, device=False):
     """Shared column-generation core.  Returns (best_lb, state, info)
     where state carries the generated column pool for the integral
     master (None when scipy is absent or the instance is empty)."""
@@ -110,7 +140,8 @@ def _colgen(problem, iters, time_limit_s, pricing_time_limit_s,
     base = lpbound.class_lp_bound(problem)
     if base is None:
         base = lpbound.dual_feasible_bound(problem)
-    info = {"method": "gg", "base_lp": base, "iters": 0, "converged": False}
+    info = {"method": "gg", "base_lp": base, "iters": 0, "converged": False,
+            "pricing_screen": "device" if device else "highs"}
     if problem.num_options == 0 or problem.num_classes == 0:
         return 0.0, None, info
 
@@ -184,33 +215,42 @@ def _colgen(problem, iters, time_limit_s, pricing_time_limit_s,
         added = 0
         farley_valid = True   # every option's pricing ratio accounted for
         proven = True         # every option priced out or MILP-optimal
+        jobs = []
         for j in range(O):
             mask = compat[:, j] & (m[:, j] > 0) & (duals > 1e-9)
-            if not mask.any():
-                continue
-            idx = np.nonzero(mask)[0]
-            ub = np.minimum(m[idx, j], cnt[idx])
+            if mask.any():
+                idx = np.nonzero(mask)[0]
+                jobs.append((j, idx, np.minimum(m[idx, j], cnt[idx])))
+        # one vmapped PDHG dispatch replaces the serial HiGHS screens
+        dev_ub = _device_screen(jobs, duals, req, alloc) if device else None
+        for j, idx, ub in jobs:
             A_p = sparse.csr_matrix(req[idx].T)
             # fractional pricing bound filters options that cannot violate
-            lp = linprog(-duals[idx], A_ub=A_p, b_ub=alloc[j],
-                         bounds=np.stack([np.zeros(len(idx)), ub], axis=1),
-                         method="highs")
-            if not lp.success:
-                # Farley needs EVERY option's ratio; an unpriced option
-                # invalidates this iteration's bound (not the run)
-                farley_valid = False
-                proven = False
-                continue
-            if -lp.fun <= price[j] * (1 + 1e-9):
+            if dev_ub is not None:
+                lp_ub = dev_ub[j]   # certified even if PDHG hit its cap
+            else:
+                lp = linprog(-duals[idx], A_ub=A_p, b_ub=alloc[j],
+                             bounds=np.stack([np.zeros(len(idx)), ub],
+                                             axis=1),
+                             method="highs")
+                if not lp.success:
+                    # Farley needs EVERY option's ratio; an unpriced option
+                    # invalidates this iteration's bound (not the run)
+                    farley_valid = False
+                    proven = False
+                    continue
+                lp_ub = -lp.fun
+            if lp_ub <= price[j] * (1 + 1e-9):
                 continue     # proven non-violating by the relaxation
             res = milp(-duals[idx],
                        constraints=[LinearConstraint(A_p, -np.inf, alloc[j])],
                        integrality=np.ones(len(idx)), bounds=Bounds(0, ub),
                        options={"time_limit": float(pricing_time_limit_s)})
             if res.status != 0 or res.x is None:
-                # LP value safely over-estimates the pricing optimum —
-                # Farley stays valid, but the master is NOT proven optimal
-                worst = max(worst, (-lp.fun + eps_omit) / price[j])
+                # the screen bound safely over-estimates the pricing
+                # optimum — Farley stays valid, but the master is NOT
+                # proven optimal
+                worst = max(worst, (lp_ub + eps_omit) / price[j])
                 proven = False
                 continue
             val = -res.fun

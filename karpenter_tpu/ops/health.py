@@ -43,6 +43,12 @@ log = logging.getLogger("karpenter_tpu.health")
 RUNGS = ("sharded", "jax", "native", "greedy")
 RUNG_INDEX = {r: i for i, r in enumerate(RUNGS)}
 
+# The LP solver ladder (DeviceLP gate): the vmapped PDHG solver in
+# ops/lpsolve.py sits above the host HiGHS path.  HiGHS is the bottom
+# rung — exact, host-only, terminates — so it never demotes, exactly
+# like "greedy" in the packing ladder.
+LP_RUNGS = ("device_lp", "highs")
+
 DEMOTE_AFTER_ERRORS = 2       # consecutive errors before demotion
 DEFAULT_WINDOW_S = 60.0       # first demotion window
 DEFAULT_WINDOW_MAX_S = 600.0  # doubling cap
@@ -66,33 +72,41 @@ class SolverHealth:
     def __init__(self, clock: Callable[[], float] = time.monotonic,
                  demote_after: int = DEMOTE_AFTER_ERRORS,
                  window_s: float = DEFAULT_WINDOW_S,
-                 window_max_s: float = DEFAULT_WINDOW_MAX_S):
+                 window_max_s: float = DEFAULT_WINDOW_MAX_S,
+                 rungs: tuple = RUNGS):
         self.clock = clock
         self.demote_after = max(1, int(demote_after))
         self.window_s = float(window_s)
         self.window_max_s = float(window_max_s)
-        self._state: Dict[str, _RungState] = {r: _RungState() for r in RUNGS}
+        self.rungs = tuple(rungs)
+        if len(self.rungs) < 2:
+            raise ValueError("ladder needs at least two rungs")
+        self.rung_index = {r: i for i, r in enumerate(self.rungs)}
+        self._state: Dict[str, _RungState] = {r: _RungState()
+                                              for r in self.rungs}
         # deterministic transition tally for reports: "from>to:reason" → n
         self.transitions: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
-    def active_rung(self, requested: str = "jax") -> str:
+    def active_rung(self, requested: Optional[str] = None) -> str:
         """Best non-demoted rung at or below `requested`.  An expired
         demotion window turns the rung into a half-open probe: it is
         offered exactly once; failure re-demotes, success promotes."""
+        if requested is None:
+            requested = "jax" if "jax" in self.rung_index else self.rungs[0]
         now = self.clock()
-        for rung in RUNGS[RUNG_INDEX[requested]:]:
+        for rung in self.rungs[self.rung_index[requested]:]:
             st = self._state[rung]
             if st.demoted_until <= now:
                 if st.demotions and not st.probing:
                     st.probing = True
                     log.info("solver rung %s: half-open probe", rung)
                 return rung
-        return "greedy"  # unreachable: greedy never demotes
+        return self.rungs[-1]  # unreachable: bottom rung never demotes
 
     def next_rung(self, rung: str) -> Optional[str]:
-        i = RUNG_INDEX[rung] + 1
-        return RUNGS[i] if i < len(RUNGS) else None
+        i = self.rung_index[rung] + 1
+        return self.rungs[i] if i < len(self.rungs) else None
 
     # ------------------------------------------------------------------
     def report_success(self, rung: str) -> None:
@@ -112,7 +126,7 @@ class SolverHealth:
         st = self._state[rung]
         st.failures += 1
         st.total_failures += 1
-        if rung == "greedy":
+        if rung == self.rungs[-1]:
             return  # bottom rung: never demoted, failures only counted
         if reason == "timeout" or st.probing or \
                 st.failures >= self.demote_after:
@@ -145,9 +159,9 @@ class SolverHealth:
                         self._state[frm].demoted_until - self.clock())
 
     def _export_rung(self) -> None:
-        # lowest healthy rung index as a gauge (0 = sharded best rung)
+        # lowest healthy rung index as a gauge (0 = best rung healthy)
         now = self.clock()
-        for i, rung in enumerate(RUNGS):
+        for i, rung in enumerate(self.rungs):
             if self._state[rung].demoted_until <= now:
                 metrics.degradation_rung().set(i)
                 return
@@ -200,7 +214,24 @@ class SolverHealth:
                     "probing": st.probing,
                     "total_failures": st.total_failures,
                     "total_demotions": st.total_demotions,
-                } for rung in RUNGS for st in (self._state[rung],)
+                } for rung in self.rungs for st in (self._state[rung],)
             },
             "transitions": dict(sorted(self.transitions.items())),
         }
+
+
+def lp_ladder(clock: Callable[[], float] = time.monotonic,
+              demote_after: int = DEMOTE_AFTER_ERRORS,
+              window_s: float = DEFAULT_WINDOW_S,
+              window_max_s: float = DEFAULT_WINDOW_MAX_S) -> SolverHealth:
+    """The DeviceLP degradation ladder: device_lp ──▶ highs.
+
+    Same state machine, demotion windows, half-open probes, metrics and
+    `solver_demotion` incident funnel as the packing ladder — only the
+    rung names differ.  Non-convergence of the PDHG solver (iteration
+    cap, residual plateau, certificate failure) reports a failure on
+    "device_lp"; after `demote_after` consecutive failures the guide
+    answers from the HiGHS path until the window expires."""
+    return SolverHealth(clock=clock, demote_after=demote_after,
+                        window_s=window_s, window_max_s=window_max_s,
+                        rungs=LP_RUNGS)

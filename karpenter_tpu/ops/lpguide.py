@@ -144,10 +144,91 @@ def _dual_certificate_ok(y: np.ndarray, mu: np.ndarray, reqf: np.ndarray,
     return float(np.abs(rc).max()) <= tol * scale
 
 
+# Device-path certificate tolerance: PDHG solves to a relative KKT
+# tolerance of ~1e-4 (f32), so strong duality / complementary slackness
+# hold to that order — the certificate still pins the SIGN convention
+# (a flipped dual is off by O(1), not O(eps)), it just stops pretending
+# the duals are vertex-exact the way HiGHS marginals are.
+_DEVICE_CERT_TOL = 1e-3
+
+
+def _report_device_failure(lp_health, reason: str) -> None:
+    """One device-master failure: count the fallback and feed the
+    DeviceLP ladder (whose `_transition` increments the demotion trip
+    counter AND publishes the `solver_demotion` incident in the same
+    function — the OB006 funnel)."""
+    metrics.lp_solves().inc({"outcome": "demoted"})
+    if lp_health is not None:
+        lp_health.report_failure("device_lp", reason)
+
+
+def _device_master(ub_rows, ub_cols, ub_vals, m_ub: int, pc, pj, P: int,
+                   nvars: int, c_obj, cnt, reqf, O: int, R: int,
+                   warm_key, lp_health):
+    """Solve one restricted master on the device (ops/lpsolve.py PDHG)
+    and validate its duals with the same sign certificate the scipy path
+    uses.  Returns (x_vars, z, y, mu) in scipy's dual convention, or
+    None after reporting the failure to the DeviceLP ladder (iteration
+    cap / certificate failure — the caller re-solves through HiGHS).
+
+    The dense operands are COMPRESSED to the active options (those with
+    at least one support pair) before padding: an inactive option
+    contributes only the degenerate row 0 − alloc_j·n_j ≤ 0 with n_j = 0
+    at the optimum and a zero marginal — HiGHS absorbs those rows
+    through sparsity, but on the dense device path a 3600-option catalog
+    would pad the envelope ~50x past the ~dozens of seeded options the
+    restricted master actually prices.  Their μ rows scatter back as 0,
+    which is exactly the marginal HiGHS reports for them."""
+    from . import lpsolve
+    act = np.unique(pj)
+    Oa = len(act)
+    newj = np.full(O, -1, np.int64)
+    newj[act] = np.arange(Oa)
+    j_of_row = ub_rows // R
+    keep = newj[j_of_row] >= 0
+    rr = newj[j_of_row[keep]] * R + ub_rows[keep] % R
+    cc = ub_cols[keep].copy()
+    isn = cc >= P
+    cc[isn] = P + newj[cc[isn] - P]
+    A_ub = np.zeros((Oa * R, P + Oa), np.float64)
+    A_ub[rr, cc] = ub_vals[keep]
+    A_eq = np.zeros((len(cnt), P + Oa), np.float64)
+    A_eq[pc, np.arange(P)] = 1.0
+    c_act = np.concatenate([c_obj[:P], c_obj[P + act]])
+    sol = lpsolve.solve_lp(c_act, A_eq=A_eq, b_eq=cnt.astype(np.float64),
+                           A_ub=A_ub, b_ub=np.zeros(Oa * R),
+                           warm_key=warm_key)
+    if not sol.converged:
+        _report_device_failure(lp_health, "cap")
+        return None
+    # HiGHS returns a vertex with clean zeros; PDHG leaves 1e-4-scale
+    # dust on non-basic entries.  Sweep it so the certificate's basic-
+    # pair selection and the striper's floors see the same support a
+    # vertex solution would.
+    dust = 1e-4 * max(1.0, float(cnt.max()) if len(cnt) else 1.0)
+    x_act = np.where(sol.x >= dust, sol.x, 0.0)
+    x_vars = np.zeros(nvars)
+    x_vars[:P] = x_act[:P]
+    x_vars[P + act] = x_act[P:]
+    z = float(c_obj @ x_vars)
+    y, mu_flat = sol.scipy_duals()
+    mu = np.zeros((O, R))
+    mu[act] = mu_flat.reshape(Oa, R)
+    if not _dual_certificate_ok(y, mu, reqf, cnt, z, pc, pj, x_vars[:P],
+                                tol=_DEVICE_CERT_TOL):
+        _report_device_failure(lp_health, "certificate")
+        return None
+    if lp_health is not None:
+        lp_health.report_success("device_lp")
+    return x_vars, z, y, mu
+
+
 def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
                  alloc: np.ndarray, price: np.ndarray,
                  pricing_rounds: int = 3, add_per_round: int = 16,
-                 tol: float = 1e-6, seed_support: Optional[np.ndarray] = None):
+                 tol: float = 1e-6, seed_support: Optional[np.ndarray] = None,
+                 device: bool = False, lp_health=None,
+                 warm_key: Optional[str] = None):
     """Class-LP optimum by option-granular column generation.  Returns
     (x C×O, objective, info) or (None, None, info) when scipy is
     unavailable or the LP fails.
@@ -207,6 +288,12 @@ def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
 
     info = {"method": "colgen-lp", "rounds": 0, "proven": False,
             "dual_check": True}
+    # device masters are only attempted while the DeviceLP ladder says
+    # the rung is healthy; a single in-call failure also stops retrying
+    # (the scipy master this round already has the operands built)
+    use_device = device and (lp_health is None or
+                             lp_health.active_rung("device_lp") ==
+                             "device_lp")
     x_full = None
     z = None
     for rnd in range(pricing_rounds):
@@ -223,20 +310,39 @@ def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
         rows.append(np.repeat(np.arange(O), R) * R + np.tile(np.arange(R), O))
         cols.append(np.repeat(np.arange(O) + P, R))
         vals.append(-allocf.reshape(-1))
-        A_ub = sparse.csr_matrix(
-            (np.concatenate(vals),
-             (np.concatenate(rows), np.concatenate(cols))),
-            shape=(O * R, nvars))
-        A_eq = sparse.csr_matrix((np.ones(P), (pc, np.arange(P))),
-                                 shape=(C, nvars))
+        ub_rows = np.concatenate(rows)
+        ub_cols = np.concatenate(cols)
+        ub_vals = np.concatenate(vals)
         c_obj = np.concatenate([np.zeros(P), pricef])
-        res = linprog(c_obj, A_ub=A_ub, b_ub=np.zeros(O * R),
-                      A_eq=A_eq, b_eq=cnt.astype(np.float64),
-                      bounds=(0, None), method="highs")
-        if not res.success:
-            return None, None, info
+        x_vars = None
+        if use_device:
+            dev = _device_master(ub_rows, ub_cols, ub_vals, O * R, pc, pj,
+                                 P, nvars, c_obj, cnt, reqf, O, R,
+                                 warm_key, lp_health)
+            if dev is None:
+                use_device = False   # demoted: HiGHS for the rest of call
+            else:
+                x_vars, z_new, y, mu = dev
+                info["method"] = "colgen-lp-device"
+                cert_tol = _DEVICE_CERT_TOL
+        if x_vars is None:
+            A_ub = sparse.csr_matrix(
+                (ub_vals, (ub_rows, ub_cols)), shape=(O * R, nvars))
+            A_eq = sparse.csr_matrix((np.ones(P), (pc, np.arange(P))),
+                                     shape=(C, nvars))
+            res = linprog(c_obj, A_ub=A_ub, b_ub=np.zeros(O * R),
+                          A_eq=A_eq, b_eq=cnt.astype(np.float64),
+                          bounds=(0, None), method="highs")
+            if not res.success:
+                return None, None, info
+            x_vars = res.x
+            z_new = float(res.fun)
+            # capacity rows (≤, duals μ ≤ 0 in scipy's sign), demand
+            # rows (=, dual y)
+            y = res.eqlin.marginals
+            mu = res.ineqlin.marginals.reshape(O, R)
+            cert_tol = 1e-5
         info["rounds"] = rnd + 1
-        z_new = float(res.fun)
         if z is not None and z_new > z - max(tol, tol * abs(z)):
             # pricing admitted options but the optimum didn't move —
             # dual-degeneracy noise, not real columns; keep the last x
@@ -244,14 +350,11 @@ def exact_lp_mix(req: np.ndarray, cnt: np.ndarray, compat: np.ndarray,
             break
         z = z_new
         x_full = np.zeros((C, O))
-        x_full[pc, pj] = res.x[:P]
-        # option pricing under the master's duals: capacity rows (≤,
-        # duals μ ≤ 0 in scipy's sign) coeff req[c,r]; demand rows (=,
-        # dual y) coeff 1 ⇒ rc(c,j) = −y_c − Σ_r μ_jr·req[c,r]
-        y = res.eqlin.marginals
-        mu = res.ineqlin.marginals.reshape(O, R)
+        x_full[pc, pj] = x_vars[:P]
+        # option pricing under the master's duals:
+        # rc(c,j) = −y_c − Σ_r μ_jr·req[c,r]
         if not _dual_certificate_ok(y, mu, reqf, cnt, z_new, pc, pj,
-                                    res.x[:P]):
+                                    x_vars[:P], tol=cert_tol):
             # the duals don't certify this master (sign-convention drift
             # or a degenerate basis): pricing with them could admit
             # garbage columns or terminate early with a false "proven".
@@ -394,13 +497,16 @@ def _round_mix(x: np.ndarray, targets: np.ndarray) -> np.ndarray:
 
 
 def _compute_mix(problem: Problem, caps: np.ndarray, stale_key=None,
-                 shape_key=None, clock=time.monotonic):
+                 shape_key=None, clock=time.monotonic, device: bool = False,
+                 lp_health=None):
     """The expensive half of the guide: feasibility mask → dedup →
     (warm-started) colgen LP → largest-remainder rounding.  Returns the
     mix entry [y, n_g, group_of, z, ok, rejected] or None, refreshing the
-    stale-guide and warm-start caches when keys are given.  Runs on the
-    provisioning tick only when no refinery is wired — otherwise in the
-    refinery worker thread."""
+    stale-guide and warm-start caches when keys are given.  With
+    `device=True` (the DeviceLP gate) the restricted masters solve on
+    the PDHG kernel — fast enough to run ON the provisioning tick, which
+    is what closes the stale-guide window; otherwise this runs in-tick
+    only when no refinery is wired, else in the refinery worker."""
     ok = _feasible_mask(problem)
     if ok.any(axis=1).sum() < 2:
         return None
@@ -420,7 +526,10 @@ def _compute_mix(problem: Problem, caps: np.ndarray, stale_key=None,
             seed = [by_content[k] for k in support if k in by_content]
     x, z, info = exact_lp_mix(problem.class_requests, cnt_lp,
                               d_compat, d_alloc, d_price,
-                              seed_support=seed)
+                              seed_support=seed, device=device,
+                              lp_health=lp_health,
+                              warm_key=(shape_key.hex() + ":master")
+                              if shape_key is not None else None)
     if x is None:
         return None
     if shape_key is not None and info.get("support") is not None:
@@ -470,13 +579,17 @@ def _stale_mix(problem: Problem, stale_key, caps: np.ndarray, now: float,
 
 
 def _refine_job(problem: Problem, caps: np.ndarray, max_nodes: int, key,
-                stale_key, shape_key, clock):
+                stale_key, shape_key, clock, device: bool = False,
+                lp_health=None):
     """Refinery worker body: compute the exact mix off the tick, land it
     in the content-keyed cache (upgrading the next tick), then price the
     greedy alternative so the refinery can raise the one-shot re-solve
-    hint when the refined mix is a real saving."""
+    hint when the refined mix is a real saving.  Background refines use
+    the device solver too when the DeviceLP rung is healthy — the same
+    ladder the in-tick path consults."""
     with tracing.span("refinery.lp"):
-        hit = _compute_mix(problem, caps, stale_key, shape_key, clock=clock)
+        hit = _compute_mix(problem, caps, stale_key, shape_key, clock=clock,
+                           device=device, lp_health=lp_health)
     if hit is None:
         return None
     with tracing.span("refinery.price") as sp:
@@ -490,7 +603,7 @@ def _refine_job(problem: Problem, caps: np.ndarray, max_nodes: int, key,
 
 def solve_guided(problem: Problem, max_alternatives: int = 60,
                  max_nodes: int = 8192, ng_slack: float = 1.0,
-                 refinery=None):
+                 refinery=None, device_lp: bool = False, lp_health=None):
     """LP-guided solve: stripe the LP mix into concrete node fills, then
     run the pack kernel on what the LP cannot see.
 
@@ -516,6 +629,15 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     by the refinery's staleness window), else the caller falls back to
     greedy for this tick — either way the exact problem signature is
     enqueued and the refined mix upgrades the next tick.
+
+    With `device_lp` (the DeviceLP gate; inherited from the refinery's
+    wiring when one is attached) a miss is answered by the PDHG solver
+    IN the same tick — the refine completes synchronously, the
+    stale-guide window closes, and no refine job is enqueued.  Only when
+    the device path fails (non-convergence or certificate failure, which
+    demote the `lp_health` ladder and publish a solver_demotion
+    incident) does the miss fall back to the stale/greedy + background-
+    refine behavior above — the HiGHS rung of the LP ladder.
     """
     from .classpack import resolve_alternatives, solve_classpack
     from .ffd import NodeDecision, PackingResult
@@ -527,9 +649,37 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
     caps = (problem.class_node_cap if problem.class_node_cap is not None
             else np.full(C0, _BIG, np.int32))
 
+    if refinery is not None:
+        device_lp = device_lp or getattr(refinery, "device_lp", False)
+        lp_health = lp_health if lp_health is not None else \
+            getattr(refinery, "lp_health", None)
+
     key, stale_key, shape_key = _mix_keys(problem, caps, max_nodes)
+    if device_lp:
+        # device mixes are valid but not byte-equal to HiGHS mixes
+        # (first-order vs vertex optimum of the same LP) — namespace the
+        # cache keys so gate-on and gate-off runs sharing one process
+        # never serve each other's mixes (golden determinism)
+        key, stale_key, shape_key = (b"d" + key, b"d" + stale_key,
+                                     b"d" + shape_key)
     hit = _MIX_CACHE.get(key)
     path = "warm"
+    if hit is None:
+        device_ok = device_lp and (lp_health is None or
+                                   lp_health.active_rung("device_lp") ==
+                                   "device_lp")
+        if device_ok:
+            # DeviceLP rung healthy: refine synchronously ON the tick —
+            # the PDHG masters are fast enough that a cold miss ships a
+            # refined (non-greedy) guide with no stale window
+            clock = refinery.clock if refinery is not None \
+                else time.monotonic
+            hit = _compute_mix(problem, caps, stale_key, shape_key,
+                               clock=clock, device=True,
+                               lp_health=lp_health)
+            if hit is not None:
+                _cache_put(_MIX_CACHE, _MIX_CACHE_MAX, key, hit)
+                path = "device"
     if hit is None:
         if refinery is not None:
             # never block the tick on column generation: serve the
@@ -538,7 +688,7 @@ def solve_guided(problem: Problem, max_alternatives: int = 60,
                              refinery.stale_ttl)
             refinery.submit(key, lambda: _refine_job(
                 problem, caps, max_nodes, key, stale_key, shape_key,
-                refinery.clock))
+                refinery.clock, device=device_lp, lp_health=lp_health))
             if hit is None:
                 metrics.lpguide_requests().inc({"path": "cold"})
                 tracing.annotate(guide_path="cold")

@@ -1,0 +1,537 @@
+"""Device-resident batched LP solver: restarted PDHG (PDLP-style).
+
+The refinery's guide quality rests on a column-generation LP that
+historically round-tripped to scipy/HiGHS on the host: a cold mix-cache
+miss answered the tick greedy and waited a full background refine before
+the guide landed (the stale-guide window).  This module closes that
+window with a pure-JAX primal-dual hybrid gradient solver in the PDLP
+mold — dense padded operands bucketed like the classpack kernel, one
+jit'd `lax.while_loop` for the iterate loop, and a **batch axis** so the
+restricted masters of many nodepools (or the per-candidate pricing LPs
+ggbound.py used to solve serially) amortize one dispatch.
+
+Problem form (everything the guide needs fits it):
+
+    min  c·x    s.t.  A x = b,   G x ≤ h,   0 ≤ x ≤ u       (u may be +inf)
+
+with the saddle-point iteration over L(x, y, λ) = c·x + y·(Ax−b) + λ·(Gx−h):
+
+    x⁺ = clip(x − τ(c + Aᵀy + Gᵀλ), 0, u)        τ = η/ω
+    y⁺ = y + σ(A(2x⁺−x) − b)                      σ = η·ω
+    λ⁺ = max(0, λ + σ(G(2x⁺−x) − h))
+
+η comes from a power-iteration bound on ‖[A;G]‖₂ after Ruiz row/column
+equilibration; the primal weight ω rebalances on restarts from the
+observed ‖Δ(y,λ)‖/‖Δx‖ ratio, exactly the PDLP recipe.  Every
+`check_every` iterations the loop scores BOTH the current iterate and
+the running epoch average against the unscaled KKT residuals (primal
+infeasibility, dual infeasibility, duality gap — all relative), adopts
+the better candidate, restarts the average on sufficient decay, and
+freezes instances that converged so a batch reproduces each member's
+solo trajectory.
+
+Sign convention vs scipy: scipy's `res.eqlin.marginals` is ∂z/∂b = −y
+and `res.ineqlin.marginals` is −λ, so `scipy_duals()` flips signs and
+the existing dual-sign certificate in lpguide.py validates PDHG duals
+verbatim.  This solver is deliberately approximate (first-order, f32):
+callers that need a *bound* must repair duals into a certificate
+(lpbound.dual_feasible_bound style) rather than trust the primal value;
+`certified_upper_bound()` below does exactly that for the pricing LPs.
+
+Padding is EXACT, not approximate: a padded variable has a zero column,
+zero cost and u=0 (the projection pins it to 0); a padded row has zero
+coefficients and zero rhs (its multiplier never moves).  The warm-start
+cache keyed by caller digests is a stateful cache, so it has a
+state/snapshot.py section and chaos × restart coverage like every other
+one (ROADMAP hygiene).
+
+Row equilibration happens HOST-SIDE in f64 before the f32 cast: each
+eq/ineq row and its rhs are divided by the row's ∞-norm, and the
+returned multipliers are divided by the same factor so callers see
+duals in their original row units.  This is not an optimization knob —
+the refinery masters mix millicore- and byte-scale capacity rows, and a
+1e6-magnitude coefficient times a ~1e2 primal value carries ~1e1 of f32
+round-off per dot product, which swamps the relative KKT measurement
+entirely (the iterate converges but the residual floor sits near 1).
+Normalized rows keep every product near the iterate's own magnitude, so
+the f32 residuals measure the LP instead of the unit system.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import metrics, tracing
+from .tensorize import pad_to
+
+# Dim buckets for LP operands.  Masters are small (tens to low thousands
+# of columns) next to the classpack pod axis, so the ladder starts low;
+# past the last bucket pad_to falls back to the next power of two.
+LP_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+DEFAULT_EPS = 1e-4        # relative KKT tolerance (f32 solver)
+DEFAULT_ITERS_CAP = 20000
+DEFAULT_CHECK_EVERY = 32
+_RESTART_DECAY = 0.36     # sufficient-decay restart threshold (PDLP β)
+_RESTART_LEN = 512        # artificial restart: epoch length cap (iters)
+
+STATUS_CONVERGED = "converged"
+STATUS_CAP = "cap"
+
+_WARM_MAX = 64
+_WARM_LOCK = threading.Lock()
+_WARM_CACHE: "OrderedDict[str, Dict]" = OrderedDict()
+
+
+@dataclass
+class LPSolution:
+    """One instance's unpadded solve result (numpy, natural dims)."""
+    x: np.ndarray           # primal (n,)
+    y: np.ndarray           # eq multipliers, L-convention (me,)
+    lam: np.ndarray         # ineq multipliers ≥ 0, L-convention (mi,)
+    obj: float              # c·x
+    status: str             # STATUS_CONVERGED | STATUS_CAP
+    iterations: int
+    restarts: int
+    primal_res: float       # relative residuals at exit
+    dual_res: float
+    gap: float
+
+    @property
+    def converged(self) -> bool:
+        return self.status == STATUS_CONVERGED
+
+    def scipy_duals(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(eqlin.marginals, ineqlin.marginals) in scipy's sign
+        convention: ∂z/∂b = −y, ∂z/∂h = −λ ≤ 0.  Feeds the lpguide
+        dual-sign certificate unchanged."""
+        return -self.y, -self.lam
+
+
+# ---------------------------------------------------------------------------
+# the jit'd kernel
+# ---------------------------------------------------------------------------
+
+def _kkt(A, b, G, h, c, u, u_fin, u_free, rhs_nrm, c_nrm, dc, de, di,
+         x, y, lam):
+    """Relative KKT score of a SCALED iterate, measured in the original
+    (unscaled) space: primal/dual infeasibility and duality gap."""
+    xo = dc * x
+    yo = de * y
+    lo = di * lam
+    r_eq = jnp.einsum("bmn,bn->bm", A, xo) - b
+    r_ub = jnp.maximum(jnp.einsum("bmn,bn->bm", G, xo) - h, 0.0)
+    pres = jnp.maximum(jnp.max(jnp.abs(r_eq), axis=1),
+                       jnp.max(r_ub, axis=1)) / (1.0 + rhs_nrm)
+    rc = c + jnp.einsum("bmn,bm->bn", A, yo) + \
+        jnp.einsum("bmn,bm->bn", G, lo)
+    dres = jnp.max(jnp.maximum(-rc, 0.0) * u_free, axis=1) / (1.0 + c_nrm)
+    pobj = jnp.sum(c * xo, axis=1)
+    dobj = -jnp.sum(b * yo, axis=1) - jnp.sum(h * lo, axis=1) + \
+        jnp.sum(jnp.minimum(rc, 0.0) * u_fin, axis=1)
+    gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+    score = jnp.maximum(jnp.maximum(pres, dres), gap)
+    return score, pres, dres, gap
+
+
+@partial(jax.jit, static_argnames=("iters_cap", "check_every"),
+         donate_argnames=("init_x", "init_y", "init_lam"))
+def _pdhg_kernel(A, b, G, h, c, u, init_x, init_y, init_lam, eps,
+                 iters_cap: int, check_every: int):
+    """Batched restarted PDHG.  Shapes: A (B,me,n), G (B,mi,n), b (B,me),
+    h (B,mi), c/u/init_x (B,n), init_y (B,me), init_lam (B,mi), eps ().
+
+    Converged instances freeze behind `done` masks — their iterates stop
+    moving and their exit stats stop updating — so a vmapped batch
+    reproduces each member's solo trajectory and the loop only runs
+    until the stragglers finish or the cap lands."""
+    f32 = jnp.float32
+    A = A.astype(f32)
+    G = G.astype(f32)
+    b = b.astype(f32)
+    h = h.astype(f32)
+    c = c.astype(f32)
+    u = u.astype(f32)
+    B, me, n = A.shape
+    mi = G.shape[1]
+    tiny = f32(1e-12)
+
+    u_free = jnp.isinf(u).astype(f32)          # vars with no upper bound
+    u_fin = jnp.where(jnp.isinf(u), 0.0, u)    # finite bounds (0 for free)
+    rhs_nrm = jnp.maximum(jnp.max(jnp.abs(b), axis=1, initial=0.0),
+                          jnp.max(jnp.abs(h), axis=1, initial=0.0))
+    c_nrm = jnp.max(jnp.abs(c), axis=1, initial=0.0)
+
+    # --- Ruiz equilibration: D_r [A;G] D_c, scales kept for unscaling.
+    def ruiz_step(_, carry):
+        As, Gs, de, di, dc = carry
+        re = jnp.max(jnp.abs(As), axis=2)
+        ri = jnp.max(jnp.abs(Gs), axis=2)
+        se = jnp.where(re > tiny, 1.0 / jnp.sqrt(jnp.maximum(re, tiny)), 1.0)
+        si = jnp.where(ri > tiny, 1.0 / jnp.sqrt(jnp.maximum(ri, tiny)), 1.0)
+        As = As * se[:, :, None]
+        Gs = Gs * si[:, :, None]
+        col = jnp.maximum(jnp.max(jnp.abs(As), axis=1, initial=0.0),
+                          jnp.max(jnp.abs(Gs), axis=1, initial=0.0))
+        sc = jnp.where(col > tiny, 1.0 / jnp.sqrt(jnp.maximum(col, tiny)),
+                       1.0)
+        As = As * sc[:, None, :]
+        Gs = Gs * sc[:, None, :]
+        return As, Gs, de * se, di * si, dc * sc
+
+    As, Gs, de, di, dc = jax.lax.fori_loop(
+        0, 8, ruiz_step,
+        (A, G, jnp.ones((B, me), f32), jnp.ones((B, mi), f32),
+         jnp.ones((B, n), f32)))
+    # scaled data: row r of [A;G] was multiplied by d_r, so rhs scales the
+    # same way; column j by d_c, so cost scales by d_c and bounds by 1/d_c.
+    bs = b * de
+    hs = h * di
+    cs = c * dc
+    us = u / jnp.maximum(dc, tiny)             # inf stays inf, 0 stays 0
+
+    # --- ‖K‖₂ by power iteration on the scaled stacked operator.
+    v0 = 1.0 + 0.5 * jnp.cos(jnp.arange(n, dtype=f32) * f32(1.618))
+    v0 = jnp.broadcast_to(v0, (B, n))
+    v0 = v0 / jnp.sqrt(jnp.sum(v0 * v0, axis=1, keepdims=True))
+
+    def power_step(_, carry):
+        v, _sig = carry
+        we = jnp.einsum("bmn,bn->bm", As, v)
+        wi = jnp.einsum("bmn,bn->bm", Gs, v)
+        vn = jnp.einsum("bmn,bm->bn", As, we) + \
+            jnp.einsum("bmn,bm->bn", Gs, wi)
+        nrm = jnp.sqrt(jnp.sum(vn * vn, axis=1))
+        sig = jnp.sqrt(jnp.maximum(nrm, tiny))   # v unit ⇒ ‖KᵀKv‖ → σ²
+        return vn / jnp.maximum(nrm, tiny)[:, None], sig
+
+    _, sigma = jax.lax.fori_loop(0, 24, power_step,
+                                 (v0, jnp.ones((B,), f32)))
+    sigma = jnp.maximum(sigma, f32(1e-6))
+    eta = f32(0.9) / sigma
+
+    nc = jnp.sqrt(jnp.sum(cs * cs, axis=1))
+    nrhs = jnp.sqrt(jnp.sum(bs * bs, axis=1) + jnp.sum(hs * hs, axis=1))
+    omega0 = jnp.where((nc > tiny) & (nrhs > tiny),
+                       jnp.clip(nc / jnp.maximum(nrhs, tiny), 1e-2, 1e2),
+                       1.0)
+
+    x0 = jnp.clip(init_x.astype(f32) / jnp.maximum(dc, tiny), 0.0, us)
+    y0 = init_y.astype(f32) / jnp.maximum(de, tiny)
+    l0 = jnp.maximum(init_lam.astype(f32) / jnp.maximum(di, tiny), 0.0)
+    zf = jnp.zeros((B,), f32)
+    zi = jnp.zeros((B,), jnp.int32)
+
+    carry0 = dict(
+        x=x0, y=y0, lam=l0,
+        xs=jnp.zeros_like(x0), ys=jnp.zeros_like(y0),
+        ls=jnp.zeros_like(l0), elen=zi,
+        xa=x0, ya=y0, la=l0, score_anc=jnp.full((B,), jnp.inf, f32),
+        omega=omega0, done=jnp.zeros((B,), bool),
+        iters=zi, restarts=zi, pres=zf, dres=zf, gap=zf,
+        k=jnp.int32(0))
+
+    restart_len = max(_RESTART_LEN // check_every, 2)
+
+    def cond(cr):
+        return jnp.logical_and(cr["k"] * check_every < iters_cap,
+                               jnp.any(~cr["done"]))
+
+    def body(cr):
+        live = ~cr["done"]
+        livec = live[:, None].astype(f32)
+        tau = (eta / cr["omega"])[:, None]
+        sig = (eta * cr["omega"])[:, None]
+
+        def step(_, st):
+            x, y, lam, xs, ys, ls = st
+            kty = jnp.einsum("bmn,bm->bn", As, y) + \
+                jnp.einsum("bmn,bm->bn", Gs, lam)
+            xn = jnp.clip(x - tau * (cs + kty), 0.0, us)
+            xb = 2.0 * xn - x
+            yn = y + sig * (jnp.einsum("bmn,bn->bm", As, xb) - bs)
+            ln = jnp.maximum(
+                lam + sig * (jnp.einsum("bmn,bn->bm", Gs, xb) - hs), 0.0)
+            xn = jnp.where(live[:, None], xn, x)
+            yn = jnp.where(live[:, None], yn, y)
+            ln = jnp.where(live[:, None], ln, lam)
+            return xn, yn, ln, xs + livec * xn, ys + livec * yn, \
+                ls + livec * ln
+
+        x, y, lam, xs, ys, ls = jax.lax.fori_loop(
+            0, check_every, step,
+            (cr["x"], cr["y"], cr["lam"], cr["xs"], cr["ys"], cr["ls"]))
+        elen = cr["elen"] + jnp.int32(check_every) * live
+
+        # score current iterate and epoch average, adopt the better
+        div = jnp.maximum(elen, 1).astype(f32)[:, None]
+        score_c, pc_, dc_, gc_ = _kkt(A, b, G, h, c, u, u_fin, u_free,
+                                      rhs_nrm, c_nrm, dc, de, di, x, y, lam)
+        score_a, pa_, da_, ga_ = _kkt(A, b, G, h, c, u, u_fin, u_free,
+                                      rhs_nrm, c_nrm, dc, de, di,
+                                      xs / div, ys / div, ls / div)
+        use_avg = score_a < score_c
+        ua = use_avg[:, None]
+        bx = jnp.where(ua, xs / div, x)
+        by = jnp.where(ua, ys / div, y)
+        bl = jnp.where(ua, ls / div, lam)
+        bscore = jnp.minimum(score_a, score_c)
+        bpres = jnp.where(use_avg, pa_, pc_)
+        bdres = jnp.where(use_avg, da_, dc_)
+        bgap = jnp.where(use_avg, ga_, gc_)
+
+        newly = live & (bscore <= eps)
+        suff = bscore <= f32(_RESTART_DECAY) * cr["score_anc"]
+        long_epoch = elen >= jnp.int32(restart_len * check_every)
+        adopt = live & (suff | long_epoch | newly)
+
+        # PDLP primal-weight rebalance from the restart displacement
+        dxn = jnp.sqrt(jnp.sum((bx - cr["xa"]) ** 2, axis=1))
+        dyn = jnp.sqrt(jnp.sum((by - cr["ya"]) ** 2, axis=1) +
+                       jnp.sum((bl - cr["la"]) ** 2, axis=1))
+        ok = (dxn > tiny) & (dyn > tiny)
+        om_new = jnp.clip(
+            jnp.exp(0.5 * jnp.log(jnp.maximum(dyn, tiny) /
+                                  jnp.maximum(dxn, tiny)) +
+                    0.5 * jnp.log(cr["omega"])), 1e-3, 1e3)
+        omega = jnp.where(adopt & ok & ~newly, om_new, cr["omega"])
+
+        ad = adopt[:, None]
+        return dict(
+            x=jnp.where(ad, bx, x), y=jnp.where(ad, by, y),
+            lam=jnp.where(ad, bl, lam),
+            xs=jnp.where(ad, 0.0, xs), ys=jnp.where(ad, 0.0, ys),
+            ls=jnp.where(ad, 0.0, ls),
+            elen=jnp.where(adopt, 0, elen),
+            xa=jnp.where(ad, bx, cr["xa"]),
+            ya=jnp.where(ad, by, cr["ya"]),
+            la=jnp.where(ad, bl, cr["la"]),
+            score_anc=jnp.where(adopt, bscore, cr["score_anc"]),
+            omega=omega, done=cr["done"] | newly,
+            iters=cr["iters"] + jnp.int32(check_every) * live,
+            restarts=cr["restarts"] + (adopt & ~newly),
+            pres=jnp.where(live, bpres, cr["pres"]),
+            dres=jnp.where(live, bdres, cr["dres"]),
+            gap=jnp.where(live, bgap, cr["gap"]),
+            k=cr["k"] + 1)
+
+    out = jax.lax.while_loop(cond, body, carry0)
+    return (dc * out["x"], de * out["y"], di * out["lam"], out["done"],
+            out["iters"], out["restarts"], out["pres"], out["dres"],
+            out["gap"])
+
+
+# ---------------------------------------------------------------------------
+# host wrapper: pad → stack → kernel → unpad
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LPInstance:
+    """One LP in natural dims; eq/ineq blocks optional, u entries may be
+    +inf (the default when `upper` is None)."""
+    c: np.ndarray
+    A_eq: Optional[np.ndarray] = None
+    b_eq: Optional[np.ndarray] = None
+    A_ub: Optional[np.ndarray] = None
+    b_ub: Optional[np.ndarray] = None
+    upper: Optional[np.ndarray] = None
+    warm_key: Optional[str] = None
+
+    def dims(self) -> Tuple[int, int, int]:
+        n = int(np.asarray(self.c).shape[0])
+        me = 0 if self.A_eq is None else int(np.asarray(self.A_eq).shape[0])
+        mi = 0 if self.A_ub is None else int(np.asarray(self.A_ub).shape[0])
+        return n, me, mi
+
+
+def _warm_get(key: Optional[str], dims: Tuple[int, int, int]):
+    if key is None:
+        return None
+    with _WARM_LOCK:
+        ent = _WARM_CACHE.get(key)
+        if ent is None or ent["dims"] != tuple(dims):
+            return None
+        _WARM_CACHE.move_to_end(key)
+        return ent
+
+
+def _warm_put(key: Optional[str], dims: Tuple[int, int, int],
+              x: np.ndarray, y: np.ndarray, lam: np.ndarray) -> None:
+    if key is None:
+        return
+    with _WARM_LOCK:
+        _WARM_CACHE[key] = {"dims": tuple(dims),
+                            "x": np.asarray(x, np.float32).copy(),
+                            "y": np.asarray(y, np.float32).copy(),
+                            "lam": np.asarray(lam, np.float32).copy()}
+        _WARM_CACHE.move_to_end(key)
+        while len(_WARM_CACHE) > _WARM_MAX:
+            _WARM_CACHE.popitem(last=False)
+
+
+def warm_cache_len() -> int:
+    with _WARM_LOCK:
+        return len(_WARM_CACHE)
+
+
+def snapshot_caches() -> dict:
+    """Plain-data export of the warm-start cache for the WarmRestart
+    snapshot (state/snapshot.py "lpsolve" section): keys are caller
+    digests, values natural-dim float32 arrays — all picklable and
+    clock-domain free (a warm start is only ever a hint)."""
+    with _WARM_LOCK:
+        return {"warm": {k: dict(v) for k, v in _WARM_CACHE.items()}}
+
+
+def restore_caches(data: dict) -> None:
+    with _WARM_LOCK:
+        _WARM_CACHE.clear()
+        for k, v in data.get("warm", {}).items():
+            _WARM_CACHE[k] = {"dims": tuple(v["dims"]),
+                              "x": np.asarray(v["x"], np.float32),
+                              "y": np.asarray(v["y"], np.float32),
+                              "lam": np.asarray(v["lam"], np.float32)}
+        while len(_WARM_CACHE) > _WARM_MAX:
+            _WARM_CACHE.popitem(last=False)
+
+
+def reset_caches() -> None:
+    with _WARM_LOCK:
+        _WARM_CACHE.clear()
+
+
+def solve_lp_batch(instances: Sequence[LPInstance],
+                   eps: float = DEFAULT_EPS,
+                   iters_cap: int = DEFAULT_ITERS_CAP,
+                   check_every: int = DEFAULT_CHECK_EVERY,
+                   buckets: Sequence[int] = LP_BUCKETS
+                   ) -> List[LPSolution]:
+    """Solve a batch of LPs in one padded device dispatch.
+
+    All instances pad to one bucketed (n, me, mi) envelope — padding is
+    exact (see module docstring), so heterogeneous natural dims batch
+    fine.  Returns one LPSolution per instance, natural dims."""
+    if not instances:
+        return []
+    B = len(instances)
+    dims = [inst.dims() for inst in instances]
+    nb = pad_to(max(d[0] for d in dims), buckets)
+    meb = pad_to(max(max(d[1] for d in dims), 1), buckets)
+    mib = pad_to(max(max(d[2] for d in dims), 1), buckets)
+
+    A = np.zeros((B, meb, nb), np.float32)
+    G = np.zeros((B, mib, nb), np.float32)
+    b = np.zeros((B, meb), np.float32)
+    h = np.zeros((B, mib), np.float32)
+    c = np.zeros((B, nb), np.float32)
+    u = np.zeros((B, nb), np.float32)          # padded vars pinned to 0
+    ix = np.zeros((B, nb), np.float32)
+    iy = np.zeros((B, meb), np.float32)
+    il = np.zeros((B, mib), np.float32)
+
+    # per-row ∞-norm scales (f64), kept to unscale duals on the way out
+    se = np.ones((B, meb), np.float64)
+    si = np.ones((B, mib), np.float64)
+
+    for i, inst in enumerate(instances):
+        n, me, mi = dims[i]
+        c[i, :n] = np.asarray(inst.c, np.float32)
+        u[i, :n] = np.inf if inst.upper is None else \
+            np.asarray(inst.upper, np.float32)
+        if me:
+            Ae = np.asarray(inst.A_eq, np.float64)
+            s = np.abs(Ae).max(axis=1)
+            s = np.where(s > 0.0, s, 1.0)
+            se[i, :me] = s
+            A[i, :me, :n] = (Ae / s[:, None]).astype(np.float32)
+            b[i, :me] = (np.asarray(inst.b_eq, np.float64) /
+                         s).astype(np.float32)
+        if mi:
+            Gi = np.asarray(inst.A_ub, np.float64)
+            s = np.abs(Gi).max(axis=1)
+            s = np.where(s > 0.0, s, 1.0)
+            si[i, :mi] = s
+            G[i, :mi, :n] = (Gi / s[:, None]).astype(np.float32)
+            h[i, :mi] = (np.asarray(inst.b_ub, np.float64) /
+                         s).astype(np.float32)
+        warm = _warm_get(inst.warm_key, dims[i])
+        if warm is not None:
+            # cached duals are in original row units; the kernel works in
+            # row-normalized units (y' = s·y)
+            ix[i, :n] = warm["x"]
+            iy[i, :me] = warm["y"] * se[i, :me]
+            il[i, :mi] = warm["lam"] * si[i, :mi]
+
+    kw = dict(batch=B, shape=f"{nb}x{meb}x{mib}")
+    sp = tracing.span("lp.batch", **kw) if B > 1 else \
+        tracing.span("lp.solve", **kw)
+    with sp:
+        out = _pdhg_kernel(A, b, G, h, c, u, ix, iy, il,
+                           np.float32(eps), iters_cap=int(iters_cap),
+                           check_every=int(check_every))
+        xs, ys, ls, done, iters, restarts, pres, dres, gap = \
+            [np.asarray(o) for o in out]
+
+    metrics.lp_batch_size().observe(B)
+    sols: List[LPSolution] = []
+    for i, inst in enumerate(instances):
+        n, me, mi = dims[i]
+        x = xs[i, :n].astype(np.float64)
+        y = ys[i, :me].astype(np.float64) / se[i, :me]
+        lam = ls[i, :mi].astype(np.float64) / si[i, :mi]
+        ok = bool(done[i])
+        status = STATUS_CONVERGED if ok else STATUS_CAP
+        sol = LPSolution(
+            x=x, y=y, lam=lam,
+            obj=float(np.asarray(inst.c, np.float64) @ x),
+            status=status, iterations=int(iters[i]),
+            restarts=int(restarts[i]), primal_res=float(pres[i]),
+            dual_res=float(dres[i]), gap=float(gap[i]))
+        metrics.lp_solves().inc({"outcome": status})
+        metrics.lp_iterations().observe(sol.iterations)
+        metrics.lp_restarts().observe(sol.restarts)
+        if ok:
+            _warm_put(inst.warm_key, dims[i], x, y, lam)
+        sols.append(sol)
+    metrics.lp_residuals().set(float(pres.max()), {"kind": "primal"})
+    metrics.lp_residuals().set(float(dres.max()), {"kind": "dual"})
+    metrics.lp_residuals().set(float(gap.max()), {"kind": "gap"})
+    return sols
+
+
+def solve_lp(c, A_eq=None, b_eq=None, A_ub=None, b_ub=None, upper=None,
+             warm_key: Optional[str] = None, eps: float = DEFAULT_EPS,
+             iters_cap: int = DEFAULT_ITERS_CAP,
+             check_every: int = DEFAULT_CHECK_EVERY,
+             buckets: Sequence[int] = LP_BUCKETS) -> LPSolution:
+    """Single-LP convenience wrapper over `solve_lp_batch` (B=1 batch, so
+    single and batched solves share one kernel and one trajectory)."""
+    return solve_lp_batch(
+        [LPInstance(c=np.asarray(c, np.float32), A_eq=A_eq, b_eq=b_eq,
+                    A_ub=A_ub, b_ub=b_ub, upper=upper, warm_key=warm_key)],
+        eps=eps, iters_cap=iters_cap, check_every=check_every,
+        buckets=buckets)[0]
+
+
+def certified_upper_bound(d: np.ndarray, R: np.ndarray, a: np.ndarray,
+                          ub: np.ndarray, lam: np.ndarray) -> float:
+    """Certified upper bound on  max d·z  s.t.  R z ≤ a, 0 ≤ z ≤ ub,
+    from ANY λ ≥ 0 (weak duality):  a·λ + Σ_j max(0, d_j − (Rᵀλ)_j)·ub_j.
+
+    This is how ggbound consumes the batched solver: the PDHG *primal*
+    value of a pricing LP may under-estimate the max (unsafe for Farley
+    screening), but the dual-repaired bound is valid regardless of
+    convergence — at worst it is loose and the screen is conservative."""
+    lam = np.maximum(np.asarray(lam, np.float64), 0.0)
+    slack = np.maximum(np.asarray(d, np.float64) -
+                       np.asarray(R, np.float64).T @ lam, 0.0)
+    return float(np.asarray(a, np.float64) @ lam +
+                 slack @ np.asarray(ub, np.float64))

@@ -56,11 +56,18 @@ class GuideRefinery:
                  upgrade_threshold: float = 0.03,
                  clock: Callable[[], float] = time.monotonic,
                  monotonic: Callable[[], float] = time.monotonic,
-                 start: bool = True):
+                 start: bool = True, device_lp: bool = False,
+                 lp_health=None):
         self.stale_ttl = stale_ttl
         self.upgrade_threshold = upgrade_threshold
         self.clock = clock
         self.monotonic = monotonic
+        # DeviceLP wiring (operator/operator.py): with device_lp on and
+        # the lp_health ladder healthy, solve_guided refines a miss
+        # synchronously on the PDHG solver instead of enqueueing here —
+        # this queue then only carries the HiGHS-rung fallback refines.
+        self.device_lp = bool(device_lp)
+        self.lp_health = lp_health
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._lock = named_lock("refinery.inflight")
         self._inflight: set = set()     # guarded-by: _lock

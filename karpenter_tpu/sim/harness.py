@@ -106,6 +106,7 @@ class SimHarness:
                  warm_restart: Optional[bool] = None,
                  ingest_batch: Optional[bool] = None,
                  device_decode: Optional[bool] = None,
+                 device_lp: Optional[bool] = None,
                  ha_failover: Optional[bool] = None,
                  flight_recorder: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
@@ -121,7 +122,12 @@ class SimHarness:
         goldens are recorded with both off.  `device_decode` overrides the
         DeviceDecode gate (default off): columnar slab decode with
         bit-identical plans, so gate-ON replays match the same goldens for
-        scenarios whose batches clear the decode floor.  `ha_failover`
+        scenarios whose batches clear the decode floor.  `device_lp`
+        overrides the DeviceLP gate (default off): guide misses refine
+        in-tick on the PDHG solver — mixes may legitimately differ from
+        the HiGHS path's (first-order vs vertex optimum of the same LP),
+        so gate-ON runs have their own golden; every existing golden is
+        recorded with the gate off.  `ha_failover`
         overrides the HAFailover gate (default off): a virtual-clock
         LeaderElector is wired into the manager so lease expiry, fencing
         refusals, and `leader.lease` chaos replay deterministically —
@@ -160,6 +166,8 @@ class SimHarness:
             opts.feature_gates["IngestBatch"] = bool(ingest_batch)
         if device_decode is not None:
             opts.feature_gates["DeviceDecode"] = bool(device_decode)
+        if device_lp is not None:
+            opts.feature_gates["DeviceLP"] = bool(device_lp)
         self._fr_enabled = bool(flight_recorder) \
             if flight_recorder is not None else False
         if self._fr_enabled:

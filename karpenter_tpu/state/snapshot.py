@@ -8,8 +8,9 @@ This module serializes the whole control-plane working set —
 * `Cluster` dicts (nodes, claims, pods, PDBs) + mutation epoch,
 * the `ClusterArena` slab and registries (`ops/arena.py snapshot_state`),
 * solver-adjacent caches: LP mix/stale/support caches (`ops/lpguide.py`),
-  the unavailable-offerings ICE cache, the forecast demand series, the
-  solver-health ladder, and every controller supervisor's circuit state,
+  the PDHG warm-start cache (`ops/lpsolve.py`), the unavailable-offerings
+  ICE cache, the forecast demand series, the solver-health ladders
+  (packing and DeviceLP), and every controller supervisor's circuit state,
 * the fake-cloud substrate and interruption queue (so a resumed sim run
   replays the exact launch/reclaim stream), and
 * the module-level name/id counters (probe-and-reset, net-zero draws) so
@@ -103,10 +104,16 @@ def _decode_health_of(manager) -> Optional[object]:
     return getattr(prov, "decode_health", None) if prov is not None else None
 
 
+def _lp_health_of(manager) -> Optional[object]:
+    prov = manager.controllers.get("provisioning") \
+        if manager is not None else None
+    return getattr(prov, "lp_health", None) if prov is not None else None
+
+
 def collect_sections(op, manager=None) -> Dict:
     """Assemble the sections dict from a live operator (+ optional
     manager).  Caller holds the state lock; nothing here blocks."""
-    from ..ops import lpguide
+    from ..ops import lpguide, lpsolve
     cluster = op.cluster
     arena = cluster.arena
     sections: Dict[str, object] = {
@@ -115,6 +122,7 @@ def collect_sections(op, manager=None) -> Dict:
         "arena": arena.snapshot_state() if arena is not None else None,
         "unavailable": op.unavailable.snapshot_state(),
         "lpguide": lpguide.snapshot_caches(),
+        "lpsolve": lpsolve.snapshot_caches(),
         "cloud": op.raw_cloud.snapshot_state(),
         "queue": op.queue.snapshot_state() if op.queue is not None else None,
     }
@@ -137,6 +145,9 @@ def collect_sections(op, manager=None) -> Dict:
         dh = _decode_health_of(manager)
         if dh is not None:
             sections["decode"] = dh.snapshot_state()
+        lp = _lp_health_of(manager)
+        if lp is not None:
+            sections["lp_health"] = lp.snapshot_state()
         # HA leader/readiness state (operator/manager.py): present only
         # for a manager that grew the lifecycle (hasattr guards older
         # pickles and stub managers in tests)
@@ -276,7 +287,7 @@ def restore_snapshot(path: str, op, manager=None) -> str:
 
 
 def _apply_sections(sections: Dict, op, manager=None) -> None:
-    from ..ops import lpguide
+    from ..ops import lpguide, lpsolve
     from ..ops.tensorize import _CLASS_GEN
     _restore_counters(sections.get("counters", {}))
     op.cluster.restore_state(sections["cluster"])
@@ -291,6 +302,7 @@ def _apply_sections(sections: Dict, op, manager=None) -> None:
             arena.invalidate("restore_mismatch")
     op.unavailable.restore_state(sections["unavailable"])
     lpguide.restore_caches(sections.get("lpguide", {}))
+    lpsolve.restore_caches(sections.get("lpsolve", {}))
     op.raw_cloud.restore_state(sections["cloud"])
     if op.queue is not None and sections.get("queue") is not None:
         op.queue.restore_state(sections["queue"])
@@ -320,6 +332,9 @@ def _apply_sections(sections: Dict, op, manager=None) -> None:
         dh = _decode_health_of(manager)
         if dh is not None and "decode" in sections:
             dh.restore_state(sections["decode"])
+        lp = _lp_health_of(manager)
+        if lp is not None and "lp_health" in sections:
+            lp.restore_state(sections["lp_health"])
         ha = getattr(manager, "ha_restore_state", None)
         if ha is not None and sections.get("leader") is not None:
             ha(sections["leader"])

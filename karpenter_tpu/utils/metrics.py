@@ -860,6 +860,49 @@ def lpguide_requests() -> Counter:
         labels=("path",))
 
 
+def lp_solves() -> Counter:
+    """Device LP solves by outcome: converged (KKT score under tolerance),
+    cap (iteration cap landed first — the instance's result is discarded
+    and the caller re-solves on the fallback rung), demoted (a caller
+    fell back to the HiGHS rung because the DeviceLP ladder was down).
+    The outcome label is closed: {converged, cap, demoted}."""
+    return REGISTRY.counter(
+        "karpenter_lp_solves_total",
+        "PDHG LP solves by outcome (converged/cap/demoted).",
+        labels=("outcome",))
+
+
+def lp_iterations() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_lp_iterations",
+        "PDHG iterations per LP instance at exit.",
+        buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 20000))
+
+
+def lp_restarts() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_lp_restarts",
+        "PDHG average-iterate restarts per LP instance at exit.",
+        buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
+
+
+def lp_residuals() -> Gauge:
+    """Worst relative KKT residual across the last batch, by kind
+    (primal infeasibility / dual infeasibility / duality gap) — the
+    convergence margin the ladder's demotion decisions key off."""
+    return REGISTRY.gauge(
+        "karpenter_lp_residual",
+        "Relative KKT residuals at exit of the last LP batch.",
+        labels=("kind",))
+
+
+def lp_batch_size() -> Histogram:
+    return REGISTRY.histogram(
+        "karpenter_lp_batch_size",
+        "Instances per batched LP dispatch (vmap axis width).",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+
+
 def refinery_queue_depth() -> Gauge:
     return REGISTRY.gauge(
         "karpenter_lpguide_refinery_queue_depth",

@@ -58,6 +58,9 @@ SPAN_NAMES = frozenset({
     "shard.tensorize", "shard.kernel", "shard.assemble",
     # refinery + LP guide
     "refinery.refine", "refinery.lp", "refinery.price",
+    # device LP solver (ops/lpsolve.py): one dispatch of the batched
+    # PDHG kernel — lp.solve is a B=1 batch, lp.batch covers B>1
+    "lp.solve", "lp.batch",
     # forecast/headroom reconcile
     "forecast.reconcile", "forecast.model", "forecast.plan",
     "forecast.preempt",

@@ -1,0 +1,372 @@
+"""Device LP solver (ops/lpsolve.py): the restarted-PDHG kernel behind
+the DeviceLP gate.
+
+Five pinned behaviours: randomized objective/dual parity against the
+scipy/HiGHS oracle, exact padding (bucketed envelope ≡ natural dims up
+to f32 tolerance), batch ≡ loop-of-singles (the freeze mask makes each
+batch member reproduce its solo trajectory), certified bounds that stay
+valid WITHOUT convergence (weak duality from any λ ≥ 0), and the
+failure funnel — a non-convergent master demotes the DeviceLP ladder
+and publishes a `solver_demotion` incident while the guide answers from
+HiGHS."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linprog
+
+from karpenter_tpu.obs import BUS
+from karpenter_tpu.ops import lpguide, lpsolve
+from karpenter_tpu.ops.health import LP_RUNGS, lp_ladder
+from karpenter_tpu.ops.lpsolve import (LPInstance, LPSolution,
+                                       certified_upper_bound, solve_lp,
+                                       solve_lp_batch)
+
+# certified envelope for the f32 first-order solver vs the exact oracle:
+# the KKT stop at eps=1e-4 bounds the relative duality gap, so the
+# objective agrees to O(eps) — 1e-3 leaves headroom for conditioning
+RTOL = 1e-3
+
+
+@pytest.fixture(autouse=True)
+def _fresh_solver_state():
+    lpsolve.reset_caches()
+    yield
+    lpsolve.reset_caches()
+    BUS.disarm()
+
+
+def _random_lp(rng, n, me, mi):
+    """Feasible-by-construction: pick x* ∈ [0, 2]ⁿ, derive b = Ax*,
+    h = Gx* + slack.  c ≥ 0 and finite upper bounds keep the optimum
+    bounded, so HiGHS always returns an exact certificate to compare
+    against."""
+    x_star = rng.uniform(0.0, 2.0, n)
+    A = rng.uniform(-1.0, 1.0, (me, n))
+    b = A @ x_star
+    G = rng.uniform(-1.0, 1.0, (mi, n))
+    h = G @ x_star + rng.uniform(0.1, 1.0, mi)
+    c = rng.uniform(0.1, 1.0, n)
+    u = np.full(n, 4.0)
+    return c, A, b, G, h, u
+
+
+def _oracle(c, A, b, G, h, u):
+    res = linprog(c, A_ub=G, b_ub=h, A_eq=A, b_eq=b,
+                  bounds=np.stack([np.zeros(len(c)), u], axis=1),
+                  method="highs")
+    assert res.success
+    return res
+
+
+# ---------------------------------------------------------------------------
+# parity vs the HiGHS oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,me,mi", [(20, 5, 8), (40, 10, 16), (80, 20, 30)])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_objective_parity_with_highs(n, me, mi, seed):
+    rng = np.random.default_rng(1000 * seed + n)
+    c, A, b, G, h, u = _random_lp(rng, n, me, mi)
+    ref = _oracle(c, A, b, G, h, u)
+    sol = solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u)
+    assert sol.converged, (sol.status, sol.primal_res, sol.dual_res, sol.gap)
+    assert sol.obj == pytest.approx(ref.fun, rel=RTOL, abs=RTOL)
+    # the iterate is near-feasible at the certified tolerance
+    scale = 1.0 + max(np.abs(b).max(), np.abs(h).max())
+    assert np.abs(A @ sol.x - b).max() <= 1e-3 * scale
+    assert (G @ sol.x - h).max() <= 1e-3 * scale
+    assert (sol.x >= -1e-6).all() and (sol.x <= u + 1e-4).all()
+
+
+def test_duals_match_scipy_sign_convention():
+    """scipy_duals() must hand back eqlin/ineqlin marginals — the sign
+    flip that lets lpguide's dual certificate validate PDHG verbatim."""
+    rng = np.random.default_rng(7)
+    c, A, b, G, h, u = _random_lp(rng, 30, 8, 12)
+    ref = _oracle(c, A, b, G, h, u)
+    sol = solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u)
+    assert sol.converged
+    y_s, lam_s = sol.scipy_duals()
+    np.testing.assert_allclose(y_s, ref.eqlin.marginals, atol=5e-3)
+    np.testing.assert_allclose(lam_s, ref.ineqlin.marginals, atol=5e-3)
+    assert (sol.lam >= 0).all()          # L-convention multipliers ≥ 0
+    assert (lam_s <= 1e-9).all()         # scipy's ineq marginals ≤ 0
+
+
+# ---------------------------------------------------------------------------
+# padding and batching
+# ---------------------------------------------------------------------------
+
+def test_padded_vs_exact_invariance():
+    """Bucket padding is exact: the same LP solved at natural dims and
+    inside a padded envelope lands on the same optimum (f32 tolerance —
+    reduction order differs across shapes, bitwise equality does not)."""
+    rng = np.random.default_rng(11)
+    c, A, b, G, h, u = _random_lp(rng, 24, 6, 10)
+    exact = solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u,
+                     buckets=(6, 10, 24))        # natural dims, no padding
+    padded = solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u,
+                      buckets=(64,))             # everything pads to 64
+    assert exact.converged and padded.converged
+    assert padded.obj == pytest.approx(exact.obj, rel=RTOL, abs=RTOL)
+    np.testing.assert_allclose(padded.x, exact.x, atol=2e-2)
+
+
+def test_batch_matches_loop_of_singles():
+    """The done-mask freeze makes every batch member reproduce its solo
+    trajectory — a vmapped batch is a latency optimization, not a
+    different solver."""
+    rng = np.random.default_rng(3)
+    insts, singles = [], []
+    for k, (n, me, mi) in enumerate([(20, 5, 8), (28, 7, 12), (16, 4, 6)]):
+        c, A, b, G, h, u = _random_lp(rng, n, me, mi)
+        # common envelope for both paths so trajectories are comparable
+        singles.append(solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h,
+                                upper=u, buckets=(32,)))
+        insts.append(LPInstance(c=c, A_eq=A, b_eq=b, A_ub=G, b_ub=h,
+                                upper=u))
+    batch = solve_lp_batch(insts, buckets=(32,))
+    for solo, b_sol in zip(singles, batch):
+        assert b_sol.status == solo.status
+        assert b_sol.iterations == solo.iterations   # same trajectory
+        assert b_sol.obj == pytest.approx(solo.obj, rel=1e-5, abs=1e-5)
+        np.testing.assert_allclose(b_sol.x, solo.x, atol=1e-4)
+
+
+def test_empty_batch_and_bound_only_instances():
+    assert solve_lp_batch([]) == []
+    # no constraints at all: optimum pins every variable at a bound
+    sol = solve_lp(np.array([1.0, -2.0]), upper=np.array([3.0, 5.0]))
+    assert sol.converged
+    np.testing.assert_allclose(sol.x, [0.0, 5.0], atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# warm-start cache
+# ---------------------------------------------------------------------------
+
+def test_warm_start_cache_stores_and_reuses():
+    rng = np.random.default_rng(5)
+    c, A, b, G, h, u = _random_lp(rng, 24, 6, 10)
+    cold = solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u,
+                    warm_key="t:warm")
+    assert cold.converged and lpsolve.warm_cache_len() == 1
+    warm = solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u,
+                    warm_key="t:warm")
+    assert warm.converged
+    # restarting FROM the optimum converges in far fewer iterations
+    assert warm.iterations < cold.iterations
+    assert warm.obj == pytest.approx(cold.obj, rel=RTOL, abs=RTOL)
+
+
+def test_warm_cache_dim_mismatch_is_ignored():
+    rng = np.random.default_rng(6)
+    c, A, b, G, h, u = _random_lp(rng, 24, 6, 10)
+    solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u, warm_key="t:dims")
+    c2, A2, b2, G2, h2, u2 = _random_lp(rng, 30, 6, 10)
+    sol = solve_lp(c2, A_eq=A2, b_eq=b2, A_ub=G2, b_ub=h2, upper=u2,
+                   warm_key="t:dims")           # stale dims: cold start
+    ref = _oracle(c2, A2, b2, G2, h2, u2)
+    assert sol.converged
+    assert sol.obj == pytest.approx(ref.fun, rel=RTOL, abs=RTOL)
+
+
+def test_snapshot_roundtrip_preserves_warm_entries():
+    rng = np.random.default_rng(8)
+    c, A, b, G, h, u = _random_lp(rng, 20, 5, 8)
+    solve_lp(c, A_eq=A, b_eq=b, A_ub=G, b_ub=h, upper=u, warm_key="t:snap")
+    snap = lpsolve.snapshot_caches()
+    lpsolve.reset_caches()
+    assert lpsolve.warm_cache_len() == 0
+    lpsolve.restore_caches(snap)
+    assert lpsolve.warm_cache_len() == 1
+    ent = snap["warm"]["t:snap"]
+    assert tuple(ent["dims"]) == (20, 5, 8)
+
+
+# ---------------------------------------------------------------------------
+# certified bounds without convergence
+# ---------------------------------------------------------------------------
+
+def test_certified_upper_bound_dominates_oracle():
+    """Weak duality: the λ-repaired bound over-estimates the pricing
+    optimum whether or not PDHG converged — the property Farley
+    screening in ggbound depends on."""
+    rng = np.random.default_rng(9)
+    for _ in range(5):
+        nv, mr = 12, 4
+        d = rng.uniform(0.0, 1.0, nv)
+        R = rng.uniform(0.0, 1.0, (mr, nv))
+        a = rng.uniform(1.0, 3.0, mr)
+        ub = rng.uniform(0.5, 4.0, nv)
+        ref = linprog(-d, A_ub=R, b_ub=a,
+                      bounds=np.stack([np.zeros(nv), ub], axis=1),
+                      method="highs")
+        assert ref.success
+        opt = -ref.fun
+        sol = solve_lp(-d, A_ub=R, b_ub=a, upper=ub)
+        assert certified_upper_bound(d, R, a, ub, sol.lam) >= opt - 1e-6
+        # valid for ANY λ ≥ 0, even garbage — only tightness degrades
+        assert certified_upper_bound(d, R, a, ub, np.zeros(mr)) >= opt - 1e-9
+        assert certified_upper_bound(
+            d, R, a, ub, rng.uniform(0, 5, mr)) >= opt - 1e-6
+
+
+def test_iteration_cap_reports_cap_status():
+    """An infeasible instance can never meet the KKT stop: the solver
+    must exit at the cap with status='cap', never loop or raise."""
+    A = np.array([[1.0], [1.0]])
+    b = np.array([0.0, 1.0])       # x = 0 and x = 1: infeasible
+    sol = solve_lp(np.array([1.0]), A_eq=A, b_eq=b, iters_cap=256)
+    assert not sol.converged and sol.status == lpsolve.STATUS_CAP
+    assert sol.iterations <= 256
+
+
+# ---------------------------------------------------------------------------
+# the demotion funnel (lpguide device path × DeviceLP ladder × incidents)
+# ---------------------------------------------------------------------------
+
+def _tiny_master():
+    """A 3-class / 4-option master in exact_lp_mix's operand form."""
+    rng = np.random.default_rng(21)
+    req = rng.uniform(1.0, 3.0, (3, 2))
+    cnt = np.array([5, 3, 4])
+    alloc = rng.uniform(8.0, 16.0, (4, 2))
+    price = rng.uniform(1.0, 2.0, 4)
+    compat = np.ones((3, 4), bool)
+    return req, cnt, compat, alloc, price
+
+
+def test_device_master_matches_scipy_path():
+    req, cnt, compat, alloc, price = _tiny_master()
+    h = lp_ladder(clock=lambda: 0.0)
+    x_d, z_d, info_d = lpguide.exact_lp_mix(req, cnt, compat, alloc, price,
+                                            device=True, lp_health=h)
+    x_s, z_s, info_s = lpguide.exact_lp_mix(req, cnt, compat, alloc, price)
+    assert info_d["method"] == "colgen-lp-device"
+    assert info_s["method"] == "colgen-lp"
+    assert z_d == pytest.approx(z_s, rel=RTOL)
+    np.testing.assert_allclose(x_d.sum(axis=1), cnt, rtol=1e-4)
+    assert h.active_rung("device_lp") == "device_lp"   # stayed healthy
+
+
+def test_nonconvergence_demotes_and_publishes_incident(monkeypatch):
+    """Two consecutive capped masters must demote device_lp → highs via
+    the ladder (OB006: the `solver_demotion` publish lives in the same
+    `_transition` as the degradation_transitions counter), while every
+    call still returns a valid HiGHS mix."""
+    req, cnt, compat, alloc, price = _tiny_master()
+
+    def capped(c, A_eq=None, b_eq=None, A_ub=None, b_ub=None, upper=None,
+               warm_key=None, **kw):
+        return LPSolution(
+            x=np.zeros(len(c)), y=np.zeros(len(b_eq)),
+            lam=np.zeros(len(b_ub)), obj=0.0, status=lpsolve.STATUS_CAP,
+            iterations=lpsolve.DEFAULT_ITERS_CAP, restarts=0,
+            primal_res=1.0, dual_res=1.0, gap=1.0)
+
+    monkeypatch.setattr(lpsolve, "solve_lp", capped)
+    seen = []
+    BUS.arm(lambda k, d, t: seen.append((k, d)), lambda: 0.0)
+    h = lp_ladder(clock=lambda: 0.0)
+
+    for _ in range(2):
+        x, z, info = lpguide.exact_lp_mix(req, cnt, compat, alloc, price,
+                                          device=True, lp_health=h)
+        assert x is not None                  # HiGHS answered in-call
+        assert info["method"] == "colgen-lp"  # device never produced a mix
+    assert h.active_rung("device_lp") == "highs"
+    kinds = [k for k, _ in seen]
+    assert kinds == ["solver_demotion"]
+    assert seen[0][1]["from"] == "device_lp"
+    assert seen[0][1]["to"] == "highs"
+
+    # demoted ladder: the guide skips the device master entirely
+    calls = []
+    monkeypatch.setattr(lpsolve, "solve_lp",
+                        lambda *a, **kw: calls.append(1) or capped(*a, **kw))
+    x, z, info = lpguide.exact_lp_mix(req, cnt, compat, alloc, price,
+                                      device=True, lp_health=h)
+    assert x is not None and calls == []
+
+
+def test_certificate_failure_demotes(monkeypatch):
+    """A converged solve with sign-flipped duals must fail the
+    certificate and fall back — a wrong-sign dual would silently invert
+    every pricing decision if it got through."""
+    req, cnt, compat, alloc, price = _tiny_master()
+    real = lpsolve.solve_lp
+
+    def flipped(*a, **kw):
+        sol = real(*a, **kw)
+        sol.y = -sol.y          # flip the eq duals: strong duality breaks
+        return sol
+
+    monkeypatch.setattr(lpsolve, "solve_lp", flipped)
+    h = lp_ladder(clock=lambda: 0.0)
+    x, z, info = lpguide.exact_lp_mix(req, cnt, compat, alloc, price,
+                                      device=True, lp_health=h)
+    assert x is not None and info["method"] == "colgen-lp"
+    assert h.rungs[0] == "device_lp"
+    assert h._state["device_lp"].failures == 1
+
+
+def test_cold_miss_ships_refined_guide_in_tick():
+    """The tentpole's point: with the DeviceLP rung healthy, a COLD
+    mix-cache miss refines synchronously on the device and the tick gets
+    a guided (non-greedy) plan — nothing is enqueued to the refinery, so
+    there is no stale-guide window to close next tick."""
+    import sys
+    sys.path.insert(0, "tests")
+    from test_lpguide import _blend_pods, _catalog_2ratio
+    from karpenter_tpu.api.objects import NodePool
+    from karpenter_tpu.ops.tensorize import tensorize
+
+    with lpguide._MIX_LOCK:
+        lpguide._MIX_CACHE.clear()
+        lpguide._STALE_CACHE.clear()
+        lpguide._SUPPORT_CACHE.clear()
+
+    class FakeRefinery:
+        device_lp = True
+        stale_ttl = 30.0
+
+        def __init__(self, lp_health):
+            self.lp_health = lp_health
+            self.submitted = []
+            self.clock = lambda: 0.0
+
+        def submit(self, key, job):
+            self.submitted.append(key)
+
+    h = lp_ladder(clock=lambda: 0.0)
+    ref = FakeRefinery(h)
+    prob = tensorize(_blend_pods(80), _catalog_2ratio(), [NodePool()])
+    res = lpguide.solve_guided(prob, refinery=ref)
+    assert res is not None                    # guided plan, same tick
+    assert not res.unschedulable
+    assert ref.submitted == []                # no background refine needed
+    assert lpsolve.warm_cache_len() >= 1      # the device master DID run
+    assert h.active_rung("device_lp") == "device_lp"
+
+
+# ---------------------------------------------------------------------------
+# the DeviceLP ladder itself
+# ---------------------------------------------------------------------------
+
+def test_lp_ladder_rungs_and_recovery():
+    assert LP_RUNGS == ("device_lp", "highs")
+    clock = [0.0]
+    h = lp_ladder(clock=lambda: clock[0])
+    assert h.active_rung("device_lp") == "device_lp"
+    h.report_failure("device_lp", "cap")
+    assert h.active_rung("device_lp") == "device_lp"   # one strike stays
+    h.report_failure("device_lp", "cap")
+    assert h.active_rung("device_lp") == "highs"       # two: demoted
+    # the bottom rung never demotes no matter how often it fails
+    for _ in range(5):
+        h.report_failure("highs", "error")
+    assert h.active_rung("device_lp") == "highs"
+    # window expiry half-opens the device rung again
+    clock[0] = 61.0
+    assert h.active_rung("device_lp") == "device_lp"

@@ -366,6 +366,51 @@ def test_golden_report_device_decode_gate_on(name):
             f"the gate changed behavior, not just decode latency")
 
 
+@pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
+                         ids=[c[0] for c in GOLDEN_CASES])
+def test_golden_report_device_lp_gate_off(name, fname, duration):
+    """DeviceLP defaults OFF; the explicit off-override must leave every
+    canned scenario's report byte-identical — the PDHG solver cannot
+    perturb a run that never routes a guide miss to the device."""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     device_lp=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"device_lp=off report for {fname} diverged from {path}")
+
+
+def test_golden_report_device_lp_gate_on():
+    """DeviceLP ON must never change WHAT a sim cluster does.  Every
+    sim batch sits under ffd.NATIVE_CUTOVER_ROWS, so provisioning takes
+    the pod-granular solve and the guided path (and with it the PDHG
+    master) never engages — the report must be byte-identical to the
+    gate-off golden.  Engagement parity at guide scale — device masters
+    matching the HiGHS mix, in-tick cold-miss refinement, demotion on
+    non-convergence — is pinned by tests/test_lpsolve.py.  Caches are
+    cleared so the assertion holds regardless of test order (device
+    mix-cache keys are namespaced, but a warm PDHG start would change
+    trajectories if the path ever did engage)."""
+    from karpenter_tpu.ops import lpguide, lpsolve
+    with lpguide._MIX_LOCK:
+        lpguide._MIX_CACHE.clear()
+        lpguide._STALE_CACHE.clear()
+        lpguide._SUPPORT_CACHE.clear()
+    lpsolve.reset_caches()
+    name, fname, duration = GOLDEN_CASES[0]  # diurnal
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration,
+                     device_lp=True).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"device_lp=on report for {fname} diverged from {path}: the "
+            f"gate changed behavior at sub-guide scale")
+
+
 _NON_HA_CASES = [c for c in GOLDEN_CASES if c[0] != "failover-drill"]
 
 

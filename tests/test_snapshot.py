@@ -413,6 +413,52 @@ def test_chaos_restart_restores_decode_breaker(tmp_path):
     assert restore_snapshot(path, op3, mgr3) == "restored"
 
 
+def test_chaos_restart_restores_lp_solver_state(tmp_path):
+    """chaos × restart for the DeviceLP solver (snapshot sections
+    "lpsolve" + "lp_health"): the PDHG warm-start cache survives a warm
+    restart — the successor's first guide miss starts from the
+    predecessor's optimum instead of a cold iterate — and a demoted
+    DeviceLP ladder stays demoted in the same clock domain, so the
+    successor answers from HiGHS instead of re-discovering the failure,
+    with the doubling window still expiring into the half-open probe."""
+    from karpenter_tpu.ops import lpsolve
+
+    clk = [1000.0]
+    path = str(tmp_path / "snap.bin")
+    clock = lambda: clk[0]
+    op, mgr = stack(clock, path, ("WarmRestart", "DeviceLP"))
+    lh = mgr.controllers["provisioning"].lp_health
+    assert lh is not None, "DeviceLP gate did not wire an lp_ladder"
+    lpsolve.reset_caches()
+    # a warm-start entry the way a converged device master stores one
+    lpsolve._warm_put("lpguide:master", (4, 2, 3),
+                      np.ones(4), np.ones(2), np.ones(3))
+    lh.report_failure("device_lp", "cap")
+    lh.report_failure("device_lp", "cap")     # second cap → demoted, 60s
+    assert lh.active_rung("device_lp") == "highs"
+    assert write_snapshot(path, op, mgr)
+
+    lpsolve.reset_caches()
+    op2, mgr2 = stack(clock, path, ("WarmRestart", "DeviceLP"))
+    assert restore_snapshot(path, op2, mgr2) == "restored"
+    lh2 = mgr2.controllers["provisioning"].lp_health
+    assert lh2 is not None
+    assert lh2.snapshot_state() == lh.snapshot_state()
+    assert lh2.active_rung("device_lp") == "highs"    # still demoted
+    assert lpsolve.warm_cache_len() == 1
+    ent = lpsolve._warm_get("lpguide:master", (4, 2, 3))
+    assert ent is not None and np.allclose(ent["x"], 1.0)
+    clk[0] += 61.0
+    assert lh2.active_rung("device_lp") == "device_lp"  # half-open probe
+
+    # a gate-off successor restores cleanly past the orphan lp_health
+    # section (the lpsolve cache is module-global and restores anyway)
+    op3, mgr3 = stack(clock, path, ("WarmRestart",))
+    assert mgr3.controllers["provisioning"].lp_health is None
+    assert restore_snapshot(path, op3, mgr3) == "restored"
+    lpsolve.reset_caches()
+
+
 def test_restart_mid_chaos_storm_converges(tmp_path):
     """Integration cut of satellite 4: random interruptions/ICE for a
     while, snapshot, 'kill' the operator (drop every object), restore a
