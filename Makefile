@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode bench-lp decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke incident-smoke deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode bench-lp decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke incident-smoke slo-smoke deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -74,6 +74,10 @@ failover-smoke: ## Replay the failover-drill scenario + the HA suite incl. the t
 incident-smoke: ## Replay chaos-storm with the flight recorder armed + run the incident suite (docs/observability.md)
 	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/chaos-storm.yaml --seed 0 --flight-recorder > /dev/null
 	$(PYTEST) tests/test_incidents.py -q
+
+slo-smoke: ## Replay spot-reclaim-storm with the SLO engine + cost ledger armed + run the SLO suite (docs/observability.md)
+	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/spot-reclaim-storm.yaml --seed 0 --slo > /dev/null
+	$(PYTEST) tests/test_slo.py -q
 
 deflake: ## Run the suite 5x to shake out order/timing flakes (Makefile:106-109)
 	for i in 1 2 3 4 5; do $(PYTEST) tests/ -q -p no:randomly || exit 1; done

@@ -50,9 +50,16 @@ rule("OB006", "observability",
      "call obs.incidents.publish_incident(kind, detail) in the same "
      "function that increments the trip counter — the flight recorder "
      "only captures what the bus sees (RS004-style funnel rule)")
+rule("OB007", "observability",
+     "SLI references a metric family that is not registered",
+     "every family literal in an obs/slo.py SLI(...) spec must name a "
+     "REGISTRY family (modulo the _count/_bucket/_sum histogram "
+     "suffixes) — a typo here silently evaluates the SLO against an "
+     "always-empty series (OB001-style two-way contract)")
 
 METRICS_MODULE = "karpenter_tpu/utils/metrics.py"
 TRACING_MODULE = "karpenter_tpu/utils/tracing.py"
+SLO_MODULE = "karpenter_tpu/obs/slo.py"
 DOCS_PAGE = "docs/metrics.md"
 
 UNBOUNDED_LABELS = {"pod", "pod_name", "uid", "provider_id", "instance_id",
@@ -134,6 +141,48 @@ def registered_families(metrics_sf: SourceFile
     return out
 
 
+_SLI_FAMILY_KEYWORDS = ("families", "bad_families", "good_families")
+_HISTOGRAM_SUFFIXES = ("_count", "_bucket", "_sum")
+
+
+def sli_family_refs(slo_sf: SourceFile) -> List[Tuple[str, int, str]]:
+    """Every family literal referenced by an `SLI(...)` spec in
+    obs/slo.py, as (family, lineno, sli_name) tuples.  An SLI call whose
+    three family keywords are all empty is surfaced as ("", lineno,
+    name) — an indicator with no inputs can never be computed."""
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(slo_sf.tree):
+        if not (isinstance(node, ast.Call) and (
+                (isinstance(node.func, ast.Name) and
+                 node.func.id == "SLI") or
+                (isinstance(node.func, ast.Attribute) and
+                 node.func.attr == "SLI"))):
+            continue
+        sli_name = next(
+            (kw.value.value for kw in node.keywords
+             if kw.arg == "name" and isinstance(kw.value, ast.Constant) and
+             isinstance(kw.value.value, str)), "?")
+        refs = 0
+        for kw in node.keywords:
+            if kw.arg not in _SLI_FAMILY_KEYWORDS:
+                continue
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and \
+                        isinstance(c.value, str) and c.value:
+                    out.append((c.value, c.lineno, sli_name))
+                    refs += 1
+        if refs == 0:
+            out.append(("", node.lineno, sli_name))
+    return out
+
+
+def _strip_histogram_suffix(family: str) -> str:
+    for suffix in _HISTOGRAM_SUFFIXES:
+        if family.endswith(suffix):
+            return family[: -len(suffix)]
+    return family
+
+
 def legacy_aliases(metrics_sf: SourceFile) -> Set[str]:
     for node in ast.walk(metrics_sf.tree):
         if isinstance(node, ast.Assign) and \
@@ -192,6 +241,9 @@ class ObservabilityChecker(Checker):
         if metrics_sf is not None:
             findings.extend(self._check_metrics_docs(metrics_sf, root))
             findings.extend(self._check_labels(metrics_sf))
+            slo_sf = by_rel.get(SLO_MODULE)
+            if slo_sf is not None:
+                findings.extend(self._check_sli_families(slo_sf, metrics_sf))
         spans = span_registry(tracing_sf) if tracing_sf is not None else set()
         for sf in sources:
             if sf.rel == TRACING_MODULE:
@@ -225,6 +277,31 @@ class ObservabilityChecker(Checker):
                 f"trip counter {family} incremented without a "
                 "publish_incident in the same function — the flight "
                 "recorder cannot see this trip"))
+        return findings
+
+    def _check_sli_families(self, slo_sf: SourceFile,
+                            metrics_sf: SourceFile) -> List[Finding]:
+        """OB007: the SLI registry must reference only registered metric
+        families — the two-way half that matters here is SLI→registry
+        (registry→docs is already OB001's job).  Histogram-derived
+        series (`_count`/`_bucket`/`_sum`) resolve to their base family.
+        """
+        findings: List[Finding] = []
+        defined = set(registered_families(metrics_sf))
+        for family, lineno, sli_name in sli_family_refs(slo_sf):
+            if family == "":
+                findings.append(Finding(
+                    "OB007", slo_sf.rel, lineno, "<module>", sli_name,
+                    f"SLI {sli_name} declares no metric families — an "
+                    "indicator with no inputs always reads empty"))
+                continue
+            if _strip_histogram_suffix(family) not in defined:
+                findings.append(Finding(
+                    "OB007", slo_sf.rel, lineno, "<module>",
+                    f"{sli_name}:{family}",
+                    f"SLI {sli_name} references unregistered family "
+                    f"{family} — the SLO would evaluate against an "
+                    "always-empty series"))
         return findings
 
     def _check_metrics_docs(self, metrics_sf: SourceFile,

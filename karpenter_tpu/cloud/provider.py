@@ -518,6 +518,22 @@ class CloudProvider:
         claim.image_id = inst.image_id
         claim.labels.update(self._instance_labels(inst, claim))
         self._claims_by_provider_id[inst.id] = claim
+        # cost-ledger seam (SLOEngine gate, free when disarmed): expected
+        # $/h is the cheapest offering this launch INTENDED (overrides[0],
+        # price-sorted upstream); realized is what the fleet landed on —
+        # they diverge exactly when ICE pushed the claim onto a pricier
+        # offering, which is the drift the ledger watches
+        from ..obs.ledger import LEDGER, current_trace_id
+        if LEDGER.enabled:
+            LEDGER.record_launch(
+                inst.id, nodepool=claim.nodepool,
+                pod_class=inst.instance_type,
+                expected_rate=overrides[0].price,
+                realized_rate=inst.price,
+                at=self.clock(),
+                fence_epoch=self.fence.epoch() if self.fence is not None
+                else 0,
+                trace_id=current_trace_id())
         return claim
 
     def _instance_labels(self, inst, claim: NodeClaim) -> Dict[str, str]:
@@ -554,6 +570,14 @@ class CloudProvider:
                 "refused")
         done = self.cloud.terminate_instances([claim.provider_id])
         claim.terminating = True
+        # ledger close: realized lifetime ends here.  The reason is the
+        # active decision context (disruption/interruption controllers
+        # tag their actuation funnels); untagged deletes are terminations.
+        from ..obs.ledger import LEDGER
+        if LEDGER.enabled:
+            LEDGER.record_close(
+                claim.provider_id, at=self.clock(),
+                reason=LEDGER.current_source(default="termination"))
         if not done:
             raise CloudError("InstanceNotFound", claim.provider_id)
 

@@ -886,8 +886,15 @@ class DisruptionController:
     # execution: taint → pre-spin replacements → rebind → terminate
     # ------------------------------------------------------------------
     def execute(self, action: Action) -> DisruptionResult:
+        # cost-ledger attribution: every launch/terminate inside this
+        # actuation funnel is tagged with the disruption reason (free
+        # when the SLOEngine gate is off — the context is a thread-local
+        # set/clear and the hooks behind it check LEDGER.enabled first)
+        from ..obs.ledger import DECISION_SOURCES, LEDGER
+        src = action.reason if action.reason in DECISION_SOURCES \
+            else "consolidation"
         with tracing.span("disruption.execute", kind=action.kind,
-                          reason=action.reason) as sp:
+                          reason=action.reason) as sp, LEDGER.decision(src):
             out = self._execute(action)
             sp.annotate(deleted=len(out.deleted), launched=len(out.launched))
             return out

@@ -151,17 +151,21 @@ class InterruptionController:
                  reason: str, out: InterruptionResult) -> bool:
         """Cordon & drain through the termination flow; evicted pods go
         pending and the provisioner replaces the capacity."""
-        if node is not None:
-            res = self.terminator.drain_sync(node, reason=reason)
-            if node.name in res.terminated:
-                out.recycled.append(node.name)
-                return True
-            return False  # drain stalled (PDBs) — retry via redelivery
-        # claim without a node (instance never registered): delete directly
-        if claim is not None:
-            try:
-                self.provider.delete(claim)
-            except Exception:  # noqa: BLE001 — vanished instance is success
-                pass
-            self.cluster.nodeclaims.pop(claim.name, None)
-        return True
+        # ledger attribution: terminations inside this funnel are spot
+        # interruptions, not voluntary consolidation
+        from ..obs.ledger import LEDGER
+        with LEDGER.decision("interruption"):
+            if node is not None:
+                res = self.terminator.drain_sync(node, reason=reason)
+                if node.name in res.terminated:
+                    out.recycled.append(node.name)
+                    return True
+                return False  # drain stalled (PDBs) — retry via redelivery
+            # claim without a node (never registered): delete directly
+            if claim is not None:
+                try:
+                    self.provider.delete(claim)
+                except Exception:  # noqa: BLE001 — vanished instance is success
+                    pass
+                self.cluster.nodeclaims.pop(claim.name, None)
+            return True

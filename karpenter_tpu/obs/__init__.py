@@ -17,12 +17,21 @@ the moment of the trip:
   * `recorder` — the `FlightRecorder` that ties them together behind the
     `FlightRecorder` feature gate (default off; gate-off runs are
     byte-identical).
+  * `slo` — the declarative SLI registry + error-budget/burn-rate engine
+    evaluated as recording rules over the ring (the `SLOEngine` gate).
+  * `ledger` — the per-decision cost ledger attributing $·h to the
+    launch/terminate decisions that spent it, with expected-vs-realized
+    drift detection (same gate as `slo`).
 
 Import discipline: `incidents` is stdlib-only so the low-level trip
 sites (utils/watchdog.py, utils/fencing.py, ops/health.py, …) can import
-it without cycles; only `recorder` reaches back into utils.
+it without cycles; `ledger` and `slo` keep their utils.metrics imports
+lazy for the same reason (the provider's launch funnel hooks the
+ledger); only `recorder` reaches back into utils eagerly.
 """
 
 from .incidents import BUS, INCIDENT_KINDS, IncidentBus, publish_incident
+from .ledger import DECISION_SOURCES, LEDGER, CostLedger
 
-__all__ = ["BUS", "INCIDENT_KINDS", "IncidentBus", "publish_incident"]
+__all__ = ["BUS", "INCIDENT_KINDS", "IncidentBus", "publish_incident",
+           "DECISION_SOURCES", "LEDGER", "CostLedger"]

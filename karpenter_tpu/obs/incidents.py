@@ -38,6 +38,8 @@ INCIDENT_KINDS = frozenset({
     "snapshot_fallback",   # warm restore fell back to a cold rebuild
     "parity_mismatch",     # arena parity probe found divergence
     "leader_loss",         # leadership lost mid-term (deposed, not released)
+    "slo_burn",            # error budget burning in both windows of a pair
+    "cost_drift",          # ledger expected-vs-realized $·h drift per pool
 })
 
 

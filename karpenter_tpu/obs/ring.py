@@ -54,6 +54,14 @@ class MetricsRing:
     def __len__(self) -> int:
         return len(self._ring)
 
+    def tip(self) -> Tuple[float, Dict]:
+        """Newest sample — the registry as of the last cadence pass (the
+        SLO engine reads cumulative counter tips from here instead of
+        re-walking the registry)."""
+        if not self._ring:
+            return (0.0, {})
+        return self._ring[-1]
+
     def window(self, start: float, end: float) -> List[Tuple[float, Dict]]:
         return [(t, snap) for t, snap in self._ring if start <= t <= end]
 

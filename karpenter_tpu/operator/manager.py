@@ -390,6 +390,27 @@ class ControllerManager:
             self.flight.provenance_cb = self._provenance_records
             self.flight.traces_cb = tracing.TRACER.traces
             self.flight.arm()
+        # SLO engine + cost ledger (SLOEngine gate): recording rules over
+        # the metric ring and per-decision $·h attribution.  When both
+        # gates are on the engine reads the recorder's ring (one sampling
+        # pass, two consumers); alone it owns a private ring.  The ledger
+        # is the process-global seam the provider's launch/terminate
+        # funnels append to — armed here, disarmed in stop().
+        self.slo = None
+        if operator.options.gate("SLOEngine"):
+            from ..obs.ledger import LEDGER
+            from ..obs.slo import SLOEngine
+            o = operator.options
+            self.slo = SLOEngine(
+                clock,
+                eval_cadence_s=getattr(o, "slo_eval_cadence_s", 60.0),
+                sample_cadence_s=getattr(o, "obs_sample_s", 30.0),
+                ring_slots=getattr(o, "obs_ring_slots", 512),
+                ring=self.flight.ring if self.flight is not None else None)
+            LEDGER.arm(
+                clock,
+                retention=getattr(o, "ledger_retention", 256),
+                drift_threshold=getattr(o, "ledger_drift_threshold", 0.15))
 
     def _chaos_state(self) -> Dict:
         return {"enabled": CHAOS.enabled, "counts": CHAOS.counts(),
@@ -477,6 +498,11 @@ class ControllerManager:
         # replica's history is exactly what the post-mortem wants)
         if self.flight is not None:
             self.flight.sample()
+        # SLO recording rules ride the same cadence discipline: sample
+        # (no-op when the recorder already owns the ring), then evaluate
+        # budgets/burn-rates on the engine's own eval cadence
+        if self.slo is not None:
+            self.slo.tick()
         # mid-tick lease guard: waiting on the state lock may have eaten
         # the whole lease; a deposed tick must abort before any mutation
         if not self._lease_live():
@@ -749,6 +775,33 @@ class ControllerManager:
         if self.flight is not None and data:
             self.flight.restore_state(data)
 
+    def slo_snapshot_state(self) -> Optional[Dict]:
+        """Error-budget state for the WarmRestart snapshot (None when the
+        SLOEngine gate is off).  Carrying the last-seen counter tips
+        forward is what lets the reset guard distinguish a restarted
+        registry from genuine new errors — no double-counting."""
+        if self.slo is None:
+            return None
+        return self.slo.snapshot_state()
+
+    def slo_restore_state(self, data: Dict) -> None:
+        if self.slo is not None and data:
+            self.slo.restore_state(data)
+
+    def ledger_snapshot_state(self) -> Optional[Dict]:
+        """Cost-ledger entries (open + closed aggregates) for the
+        WarmRestart snapshot (None when the SLOEngine gate is off)."""
+        if self.slo is None:
+            return None
+        from ..obs.ledger import LEDGER
+        return LEDGER.snapshot_state()
+
+    def ledger_restore_state(self, data: Dict) -> None:
+        if self.slo is None or not data:
+            return
+        from ..obs.ledger import LEDGER
+        LEDGER.restore_state(data)
+
     def ha_restore_state(self, data: Dict) -> None:
         """Restore the HA counters (phase itself is NOT restored: the
         restoring process is walking its own readiness ladder and must
@@ -789,6 +842,9 @@ class ControllerManager:
                                 exc_info=True)
         if self.flight is not None:
             self.flight.disarm()
+        if self.slo is not None:
+            from ..obs.ledger import LEDGER
+            LEDGER.disarm()
         if self._http is not None:
             self._http.shutdown()
         refinery = getattr(self.controllers.get("provisioning"), "refinery",
@@ -1021,6 +1077,27 @@ class ControllerManager:
                         self._json({"error": f"no bundle {bid!r}"}, 404)
                         return
                     self._json(bundle)
+                    return
+                elif url.path == "/debug/slo":
+                    # per-SLO error budgets + multi-window burn rates
+                    if manager.slo is None:
+                        self._json({"error": "SLO engine disabled; "
+                                             "start with --slo-engine"},
+                                   404)
+                        return
+                    self._json(manager.slo.summary())
+                    return
+                elif url.path == "/debug/ledger":
+                    # per-decision cost attribution + drift rollup
+                    if manager.slo is None:
+                        self._json({"error": "cost ledger disabled; "
+                                             "start with --slo-engine"},
+                                   404)
+                        return
+                    from ..obs.ledger import LEDGER
+                    out = LEDGER.summary(manager.clock())
+                    out["recent"] = LEDGER.recent(20)
+                    self._json(out)
                     return
                 elif url.path == "/debug/health":
                     # supervisor circuits + solver degradation ladder

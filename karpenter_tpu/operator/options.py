@@ -113,6 +113,15 @@ class Options:
     # fence refusals, cold restores, parity mismatches, and leader loss.
     # Off by default; enable with --flight-recorder or --feature-gates
     # FlightRecorder=true.  Knobs below.
+    # SLOEngine: the SLI/SLO layer + per-decision cost ledger
+    # (karpenter_tpu/obs/slo.py + obs/ledger.py, docs/observability.md)
+    # — error budgets and multi-window burn-rate alerts computed as
+    # recording rules over the metric ring, plus $·h attribution of
+    # every launch/terminate decision with expected-vs-realized drift
+    # detection.  Burning budgets publish `slo_burn` and drifting pools
+    # `cost_drift` incidents through the same bus the flight recorder
+    # captures.  Off by default; enable with --slo-engine or
+    # --feature-gates SLOEngine=true.  Knobs below.
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
                                  "LPRefinery": False, "Forecast": False,
@@ -123,7 +132,8 @@ class Options:
                                  "DeviceDecode": False,
                                  "DeviceLP": False,
                                  "HAFailover": False,
-                                 "FlightRecorder": False})
+                                 "FlightRecorder": False,
+                                 "SLOEngine": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -166,6 +176,10 @@ class Options:
     incident_dedup_s: float = 300.0         # per-kind publish rate limit
     incident_retention: int = 32            # bundles kept (memory + disk)
     incident_dir: str = ""                  # bundle directory ("" = memory-only)
+    # SLO-engine + cost-ledger knobs (SLOEngine gate, docs/observability.md)
+    slo_eval_cadence_s: float = 60.0        # recording-rule evaluation cadence
+    ledger_retention: int = 256             # closed ledger entries kept
+    ledger_drift_threshold: float = 0.15    # |realized-expected|/expected trip
     tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
@@ -364,6 +378,23 @@ class Options:
         p.add_argument("--obs-ring-slots", type=int,
                        default=env.get("obs_ring_slots", 512),
                        help="metric history ring capacity in samples")
+        p.add_argument("--slo-engine", action="store_true", default=False,
+                       help="arm the SLO engine + per-decision cost "
+                            "ledger: error budgets, burn-rate alerts, "
+                            "and $·h attribution (shorthand for "
+                            "--feature-gates SLOEngine=true)")
+        p.add_argument("--slo-eval-cadence", type=float,
+                       dest="slo_eval_cadence_s",
+                       default=env.get("slo_eval_cadence_s", 60.0),
+                       help="seconds between SLO recording-rule "
+                            "evaluations")
+        p.add_argument("--ledger-retention", type=int,
+                       default=env.get("ledger_retention", 256),
+                       help="closed cost-ledger entries retained")
+        p.add_argument("--ledger-drift-threshold", type=float,
+                       default=env.get("ledger_drift_threshold", 0.15),
+                       help="relative expected-vs-realized $·h drift per "
+                            "nodepool that trips a cost_drift incident")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -410,6 +441,9 @@ class Options:
             incident_dedup_s=ns.incident_dedup_s,
             incident_retention=ns.incident_retention,
             incident_dir=ns.incident_dir,
+            slo_eval_cadence_s=ns.slo_eval_cadence_s,
+            ledger_retention=ns.ledger_retention,
+            ledger_drift_threshold=ns.ledger_drift_threshold,
         )
         # env-provided gates/tags apply first; explicit --feature-gates wins
         _parse_kv_list(str(env.get("feature_gates", "")), opts.feature_gates,
@@ -436,6 +470,8 @@ class Options:
             opts.leader_elect = True  # fencing is meaningless without a lease
         if ns.flight_recorder:
             opts.feature_gates["FlightRecorder"] = True
+        if ns.slo_engine:
+            opts.feature_gates["SLOEngine"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts
@@ -480,6 +516,9 @@ class Options:
             "incident_window_s": float,
             "incident_dedup_s": float,
             "incident_retention": int,
+            "slo_eval_cadence_s": float,
+            "ledger_retention": int,
+            "ledger_drift_threshold": float,
         }
         for f in fields(Options):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())

@@ -37,6 +37,10 @@ def main(argv=None) -> int:
     p.add_argument("--flight-recorder", action="store_true",
                    help="arm the incident flight recorder (FlightRecorder "
                         "gate): the report grows an `incidents` section")
+    p.add_argument("--slo", action="store_true",
+                   help="arm the SLO engine + cost ledger (SLOEngine "
+                        "gate): the report grows `slo.budgets` and "
+                        "`ledger` sections")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -51,7 +55,8 @@ def main(argv=None) -> int:
     harness = SimHarness(scenario, seed=args.seed,
                          duration_s=args.duration,
                          flight_recorder=True if args.flight_recorder
-                         else None)
+                         else None,
+                         slo=True if args.slo else None)
     run = harness.run()
 
     doc = report_to_json(run.report)

@@ -108,7 +108,8 @@ class SimHarness:
                  device_decode: Optional[bool] = None,
                  device_lp: Optional[bool] = None,
                  ha_failover: Optional[bool] = None,
-                 flight_recorder: Optional[bool] = None):
+                 flight_recorder: Optional[bool] = None,
+                 slo: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
         twice — knobs still come from the scenario's forecast block.
@@ -135,7 +136,12 @@ class SimHarness:
         `flight_recorder` overrides the FlightRecorder gate (default
         off): the incident bus arms, the metric ring samples on the
         virtual clock, and the report grows a gated `incidents` section
-        — every golden is recorded with the gate off."""
+        — every golden is recorded with the gate off.  `slo` overrides
+        the SLOEngine gate, else the scenario's `slo.enabled` decides
+        (default off): error budgets and the cost ledger run on the
+        virtual clock and the report grows gated `slo.budgets`, `ledger`,
+        and cost-breakdown sections — every golden is recorded with the
+        gate off."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -172,6 +178,14 @@ class SimHarness:
             if flight_recorder is not None else False
         if self._fr_enabled:
             opts.feature_gates["FlightRecorder"] = True
+        ss = scenario.slo
+        self._slo_enabled = bool(slo) if slo is not None \
+            else (ss is not None and ss.enabled)
+        if self._slo_enabled:
+            opts.feature_gates["SLOEngine"] = True
+            if ss is not None:
+                opts.slo_eval_cadence_s = ss.eval_cadence_s
+                opts.ledger_drift_threshold = ss.drift_threshold
         ha = scenario.ha
         self._ha_enabled = bool(ha_failover) if ha_failover is not None \
             else (ha is not None and ha.enabled)
@@ -428,6 +442,13 @@ class SimHarness:
                 self._reclaims_honored += 1
             else:
                 self._reclaims_forced += 1
+                # a forced reclaim killed the instance without passing
+                # through the provider's delete funnel — close its ledger
+                # entry here or its realized $·h would accrue forever
+                from ..obs.ledger import LEDGER
+                if LEDGER.enabled:
+                    LEDGER.record_close(rec["instance"], at=rec["at"],
+                                        reason="spot_reclaim")
             metrics.sim_reclaims().inc(
                 {"honored": "true" if honored else "false"})
             self._log(rec["at"], {"kind": "spot_reclaim_fired",
@@ -521,6 +542,11 @@ class SimHarness:
             # a recorder whose clock and ring are gone
             if self._fr_enabled and self.mgr.flight is not None:
                 self.mgr.flight.disarm()
+            # likewise the cost ledger — but only after build_report read
+            # its summary (report building happens inside the try)
+            if self._slo_enabled:
+                from ..obs.ledger import LEDGER
+                LEDGER.disarm()
 
     def _run_gated(self) -> SimRun:
         if not self._chaos_enabled:

@@ -191,6 +191,28 @@ def build_report(harness) -> Dict:
         # dedup windows follow the same clock, and no wall-clock payloads
         # (trace timings, health latencies) are included.
         report["incidents"] = harness.mgr.flight.summary()
+    if getattr(harness, "_slo_enabled", False) and \
+            getattr(harness.mgr, "slo", None) is not None:
+        # present ONLY when the SLOEngine gate ran — same conditional
+        # contract as forecast/chaos/ha/incidents, so every gate-off
+        # report (all pre-existing goldens) stays byte-identical.  The
+        # budgets ride as a sub-key of the existing "slo" section (which
+        # every golden already carries); "ledger" and the cost breakdowns
+        # are new keys and therefore safely absent gate-off.  The ledger
+        # summary is taken at the sim-end clock so open entries accrue to
+        # exactly the instant the cost integral stopped — per-source
+        # expected $·h sums match `cost.dollar_hours` to within the
+        # launch-intent-vs-landing (ICE) divergence.
+        from ..obs.ledger import LEDGER
+        report["slo"]["budgets"] = harness.mgr.slo.summary()
+        ledger_sum = LEDGER.summary(harness.clock.now())
+        report["ledger"] = ledger_sum
+        report["cost"]["by_nodepool"] = {
+            k: v["realized_dh"]
+            for k, v in ledger_sum["by_nodepool"].items()}
+        report["cost"]["by_decision_source"] = {
+            k: v["realized_dh"]
+            for k, v in ledger_sum["by_decision_source"].items()}
     return report
 
 

@@ -280,6 +280,24 @@ class HASpec:
 
 
 @dataclass
+class SLOSpec:
+    """SLO engine + cost ledger configuration for a scenario
+    (docs/observability.md "SLO engine").  `enabled: true` turns the
+    SLOEngine gate on for the simulated operator: recording rules
+    evaluate over the virtual clock and the report grows gated
+    `slo.budgets` / `ledger` sections."""
+    enabled: bool = True
+    eval_cadence_s: float = 60.0
+    drift_threshold: float = 0.15
+
+    def validate(self) -> None:
+        if self.eval_cadence_s <= 0:
+            raise ScenarioError("slo: eval_cadence_s must be positive")
+        if self.drift_threshold <= 0:
+            raise ScenarioError("slo: drift_threshold must be positive")
+
+
+@dataclass
 class Scenario:
     name: str
     duration_s: float = 86_400.0
@@ -303,6 +321,8 @@ class Scenario:
     chaos: Optional[ChaosSpec] = None
     # fenced leadership drill (None = HAFailover gate stays off)
     ha: Optional[HASpec] = None
+    # SLO recording rules + cost ledger (None = SLOEngine gate stays off)
+    slo: Optional[SLOSpec] = None
 
     def validate(self) -> None:
         if not self.name:
@@ -328,6 +348,8 @@ class Scenario:
             self.chaos.validate()
         if self.ha is not None:
             self.ha.validate()
+        if self.slo is not None:
+            self.slo.validate()
         names = [w.name for w in self.workload]
         if len(set(names)) != len(names):
             raise ScenarioError(f"duplicate wave names: {names}")
@@ -371,6 +393,9 @@ _CHAOS_RULE_FIELDS = {
 _HA_FIELDS = {
     "enabled": bool, "ttl_s": float,
 }
+_SLO_FIELDS = {
+    "enabled": bool, "eval_cadence_s": float, "drift_threshold": float,
+}
 
 
 def _coerce(ctx: str, doc: Dict, schema: Dict) -> Dict:
@@ -399,7 +424,7 @@ def scenario_from_dict(doc: Dict) -> Scenario:
         raise ScenarioError(f"scenario document must be a mapping, "
                             f"got {type(doc).__name__}")
     known = {"name", "zones", "intervals", "workload", "faults",
-             "forecast", "chaos", "ha", *_SCENARIO_SCALARS}
+             "forecast", "chaos", "ha", "slo", *_SCENARIO_SCALARS}
     for key in doc:
         if key not in known:
             raise ScenarioError(f"unknown scenario field {key!r} "
@@ -478,6 +503,14 @@ def scenario_from_dict(doc: Dict) -> Scenario:
             if key not in _HA_FIELDS:
                 raise ScenarioError(f"ha: unknown field {key!r}")
         kw["ha"] = HASpec(**_coerce("ha", hdoc, _HA_FIELDS))
+    if doc.get("slo") is not None:
+        sdoc = doc["slo"]
+        if not isinstance(sdoc, dict):
+            raise ScenarioError("slo must be a mapping")
+        for key in sdoc:
+            if key not in _SLO_FIELDS:
+                raise ScenarioError(f"slo: unknown field {key!r}")
+        kw["slo"] = SLOSpec(**_coerce("slo", sdoc, _SLO_FIELDS))
     sc = Scenario(**kw)
     sc.validate()
     return sc

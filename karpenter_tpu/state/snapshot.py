@@ -162,6 +162,18 @@ def collect_sections(op, manager=None) -> Dict:
             incidents = inc()
             if incidents is not None:
                 sections["incidents"] = incidents
+        # SLO error budgets + cost-ledger entries (SLOEngine gate): same
+        # None-when-off contract as the incidents section above
+        slo = getattr(manager, "slo_snapshot_state", None)
+        if slo is not None:
+            slo_state = slo()
+            if slo_state is not None:
+                sections["slo"] = slo_state
+        led = getattr(manager, "ledger_snapshot_state", None)
+        if led is not None:
+            led_state = led()
+            if led_state is not None:
+                sections["ledger"] = led_state
     sections["meta"] = {
         "version": VERSION,
         "written_at": op.clock(),
@@ -341,6 +353,12 @@ def _apply_sections(sections: Dict, op, manager=None) -> None:
         inc = getattr(manager, "incidents_restore_state", None)
         if inc is not None and sections.get("incidents") is not None:
             inc(sections["incidents"])
+        slo = getattr(manager, "slo_restore_state", None)
+        if slo is not None and sections.get("slo") is not None:
+            slo(sections["slo"])
+        led = getattr(manager, "ledger_restore_state", None)
+        if led is not None and sections.get("ledger") is not None:
+            led(sections["ledger"])
 
 
 # ---------------------------------------------------------------------------

@@ -1411,3 +1411,72 @@ def obs_ring_entries() -> Gauge:
     return REGISTRY.gauge(
         "karpenter_obs_ring_entries",
         "Samples currently held in the metric history ring.")
+
+
+# ---------------------------------------------------------------------------
+# SLO-engine + cost-ledger families (docs/observability.md) — only touched
+# while the SLOEngine gate is armed, so a gate-off process never
+# materializes the series.  All label sets are closed registries: SLI
+# names from obs/slo.py DEFAULT_SLIS, window strings from
+# BURN_WINDOW_PAIRS, decision sources from obs/ledger.py
+# DECISION_SOURCES.
+# ---------------------------------------------------------------------------
+
+def slo_budget_remaining() -> Gauge:
+    """Fraction of each SLO's error budget still unspent (1.0 = clean,
+    negative = objective blown), by SLI name."""
+    return REGISTRY.gauge(
+        "karpenter_slo_error_budget_remaining",
+        "Unspent error-budget fraction per SLO.",
+        labels=("slo",))
+
+
+def slo_burn_rate() -> Gauge:
+    """Burn rate per SLO per evaluation window (1.0 = spending exactly
+    the sustainable budget; the 5m/1h alert pair trips at 14.4x)."""
+    return REGISTRY.gauge(
+        "karpenter_slo_burn_rate",
+        "Error-budget burn rate per SLO and window.",
+        labels=("slo", "window"))
+
+
+def slo_evaluations() -> Counter:
+    """SLO recording-rule evaluation passes over the metric ring."""
+    return REGISTRY.counter(
+        "karpenter_slo_evaluations_total",
+        "SLO engine evaluation passes.")
+
+
+def slo_burn_alerts() -> Counter:
+    """Multi-window burn-alert activations (the edge that publishes an
+    `slo_burn` incident), by SLI name."""
+    return REGISTRY.counter(
+        "karpenter_slo_burn_alerts_total",
+        "Burn-rate alert activations per SLO.",
+        labels=("slo",))
+
+
+def ledger_entries() -> Counter:
+    """Cost-ledger entries appended, by decision source (provisioning,
+    consolidation, interruption, spot_reclaim, headroom, …)."""
+    return REGISTRY.counter(
+        "karpenter_ledger_entries_total",
+        "Cost-ledger entries appended, by decision source.",
+        labels=("decision_source",))
+
+
+def ledger_open_entries() -> Gauge:
+    """Ledger entries still open — instances running with their $·h
+    accrual unsettled."""
+    return REGISTRY.gauge(
+        "karpenter_ledger_open_entries",
+        "Cost-ledger entries currently open.")
+
+
+def ledger_drift_alerts() -> Counter:
+    """Expected-vs-realized $·h drift detector activations (the edge
+    that publishes a `cost_drift` incident), by nodepool."""
+    return REGISTRY.counter(
+        "karpenter_ledger_drift_alerts_total",
+        "Cost-drift detector activations, by nodepool.",
+        labels=("nodepool",))

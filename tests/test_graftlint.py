@@ -613,6 +613,76 @@ def test_ob006_non_trip_family_and_obs_package_exempt():
     assert [f for f in out if f.rule == "OB006"] == []
 
 
+def test_ob007_unregistered_sli_family_flagged():
+    metrics = _sf("""
+        REGISTRY = object()
+
+        a = REGISTRY.counter("real_total", "x")
+        b = REGISTRY.histogram("real_seconds", "x")
+    """, "karpenter_tpu/utils/metrics.py")
+    slo = _sf("""
+        DEFAULT_SLIS = (
+            SLI(name="good", objective=0.99, mode="counter_ratio",
+                bad_families=("real_total",),
+                good_families=("real_seconds_count",)),
+            SLI(name="typo", objective=0.99, mode="counter_ratio",
+                bad_families=("reel_total",)),
+        )
+    """, "karpenter_tpu/obs/slo.py")
+    out = ObservabilityChecker().check_repo([metrics, slo], REPO)
+    ob7 = [f for f in out if f.rule == "OB007"]
+    assert [f.detail for f in ob7] == ["typo:reel_total"]
+
+
+def test_ob007_histogram_suffixes_resolve_to_base_family():
+    metrics = _sf("""
+        REGISTRY = object()
+
+        h = REGISTRY.histogram("lat_seconds", "x")
+    """, "karpenter_tpu/utils/metrics.py")
+    slo = _sf("""
+        DEFAULT_SLIS = (
+            SLI(name="latency", objective=0.99,
+                mode="histogram_threshold",
+                families=("lat_seconds",)),
+            SLI(name="ratio", objective=0.95, mode="counter_ratio",
+                bad_families=("lat_seconds_bucket",),
+                good_families=("lat_seconds_count", "lat_seconds_sum")),
+        )
+    """, "karpenter_tpu/obs/slo.py")
+    out = ObservabilityChecker().check_repo([metrics, slo], REPO)
+    assert [f for f in out if f.rule == "OB007"] == []
+
+
+def test_ob007_sli_with_no_families_flagged():
+    metrics = _sf("""
+        REGISTRY = object()
+
+        a = REGISTRY.counter("real_total", "x")
+    """, "karpenter_tpu/utils/metrics.py")
+    slo = _sf("""
+        DEFAULT_SLIS = (
+            SLI(name="empty", objective=0.99, mode="counter_ratio"),
+        )
+    """, "karpenter_tpu/obs/slo.py")
+    out = ObservabilityChecker().check_repo([metrics, slo], REPO)
+    ob7 = [f for f in out if f.rule == "OB007"]
+    assert [f.detail for f in ob7] == ["empty"]
+    assert "declares no metric families" in ob7[0].message
+
+
+def test_ob007_repo_sli_registry_is_clean():
+    """The live SLI registry references only registered families — the
+    two-way contract asserted against the real repo, plus its runtime
+    half: every DEFAULT_SLIS spec validates."""
+    sources = iter_sources(REPO)
+    out = ObservabilityChecker().check_repo(sources, REPO)
+    assert [f for f in out if f.rule == "OB007"] == []
+    from karpenter_tpu.obs.slo import DEFAULT_SLIS
+    for sli in DEFAULT_SLIS:
+        sli.validate()
+
+
 def test_dt001_obs_package_sim_reachable_and_clean():
     """The flight recorder runs inside the manager tick, so `obs/` is on
     the sim replay path — the determinism rules must see it (reachable)

@@ -1,0 +1,605 @@
+"""SLO engine + cost ledger suite (docs/observability.md): SLI
+computation modes over the metrics ring, error-budget accounting with
+the counter-reset guard, multi-window multi-burn-rate alerting (a
+synthetic burn spike yields exactly ONE deduped `slo_burn` bundle),
+per-decision ledger semantics (attribution context, idempotent close,
+reservation exclusion, expected-vs-realized drift edge), the chaos ×
+restart consistency drill (ledger + budgets survive a kill -9 warm
+restart without double-counting), the gated spot-reclaim-storm
+end-to-end capture, and gate-off byte-identity over every canned
+golden."""
+
+import json
+import os
+
+import pytest
+
+from karpenter_tpu.obs import BUS, publish_incident
+from karpenter_tpu.obs.ledger import (DECISION_SOURCES, LEDGER, CostLedger,
+                                      current_trace_id)
+from karpenter_tpu.obs.recorder import FlightRecorder
+from karpenter_tpu.obs.slo import (BURN_WINDOW_PAIRS, DEFAULT_SLIS, SLI,
+                                   SLOEngine, _guarded_delta)
+from karpenter_tpu.sim import SimHarness, load_scenario, report_to_json
+from karpenter_tpu.sim.scenario import SLOSpec, scenario_from_dict
+
+pytestmark = pytest.mark.sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCENARIOS = os.path.join(REPO, "scenarios")
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Bus and ledger are process-global by design; keep every test
+    hermetic by disarming both around each."""
+    BUS.disarm()
+    LEDGER.disarm()
+    yield
+    BUS.disarm()
+    LEDGER.disarm()
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class FakeRegistry:
+    """Minimal `sample_all()` source so ring tests control every value."""
+
+    def __init__(self):
+        self.series = {}
+
+    def set(self, name, value, labels=()):
+        self.series[(name, tuple(labels))] = float(value)
+
+    def sample_all(self):
+        return [(name, labels, v)
+                for (name, labels), v in sorted(self.series.items())]
+
+
+def make_engine(clock, slis, **kw):
+    reg = kw.pop("registry", None) or FakeRegistry()
+    kw.setdefault("eval_cadence_s", 60.0)
+    kw.setdefault("sample_cadence_s", 30.0)
+    return SLOEngine(clock, registry=reg, slis=tuple(slis), **kw), reg
+
+
+RATIO_SLI = SLI(name="err_ratio", objective=0.99, mode="counter_ratio",
+                bad_families=("karpenter_fake_bad_total",),
+                good_families=("karpenter_fake_good_total",))
+
+
+# ---------------------------------------------------------------------------
+# SLI registry
+# ---------------------------------------------------------------------------
+
+class TestSLIRegistry:
+    def test_default_registry_validates(self):
+        for sli in DEFAULT_SLIS:
+            sli.validate()
+        assert len(DEFAULT_SLIS) == 6
+        assert len({s.name for s in DEFAULT_SLIS}) == 6
+
+    def test_objective_must_be_a_fraction(self):
+        with pytest.raises(ValueError):
+            SLI(name="x", objective=1.0, mode="counter_ratio",
+                bad_families=("f",)).validate()
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError):
+            SLI(name="x", objective=0.9, mode="quantile",
+                families=("f",)).validate()
+
+    def test_mode_family_requirements(self):
+        with pytest.raises(ValueError):
+            SLI(name="x", objective=0.9,
+                mode="histogram_threshold").validate()
+        with pytest.raises(ValueError):
+            SLI(name="x", objective=0.9, mode="counter_ratio").validate()
+
+    def test_guarded_delta_reset_guard(self):
+        assert _guarded_delta(10.0, 4.0) == 6.0
+        # tip below last-seen = registry reset: the tip IS the delta
+        assert _guarded_delta(3.0, 10.0) == 3.0
+        assert _guarded_delta(0.0, 10.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: modes, budgets, burn alerts
+# ---------------------------------------------------------------------------
+
+class TestSLOEngine:
+    def test_counter_ratio_budget_accounting(self):
+        clk = Clock(0.0)
+        eng, reg = make_engine(clk, [RATIO_SLI])
+        reg.set("karpenter_fake_bad_total", 0.0)
+        reg.set("karpenter_fake_good_total", 0.0)
+        assert eng.tick() is True          # first eval: zero everywhere
+        clk.t = 60.0
+        reg.set("karpenter_fake_bad_total", 5.0)
+        reg.set("karpenter_fake_good_total", 95.0)
+        assert eng.tick() is True
+        s = eng.summary()["slos"]["err_ratio"]
+        assert s["bad"] == 5.0 and s["total"] == 100.0
+        # 5% errors against a 1% budget: 5x over, remaining = 1 - 5 = -4
+        assert s["budget_remaining"] == -4.0
+
+    def test_eval_cadence_gates_evaluations(self):
+        clk = Clock(0.0)
+        eng, reg = make_engine(clk, [RATIO_SLI], eval_cadence_s=60.0)
+        reg.set("karpenter_fake_bad_total", 0.0)
+        assert eng.tick() is True
+        clk.t = 30.0
+        assert eng.tick() is False         # sampled, not evaluated
+        clk.t = 60.0
+        assert eng.tick() is True
+        assert eng.evals == 2
+        assert len(eng.ring) == 3          # owns its ring: sampled each tick
+
+    def test_counter_reset_guard_never_double_counts(self):
+        clk = Clock(0.0)
+        eng, reg = make_engine(clk, [RATIO_SLI])
+        reg.set("karpenter_fake_bad_total", 0.0)
+        reg.set("karpenter_fake_good_total", 0.0)
+        eng.tick()
+        clk.t = 60.0
+        reg.set("karpenter_fake_bad_total", 5.0)
+        reg.set("karpenter_fake_good_total", 95.0)
+        eng.tick()
+        # warm restart: the registry zeroes, then re-accumulates a little
+        clk.t = 120.0
+        reg.set("karpenter_fake_bad_total", 2.0)
+        reg.set("karpenter_fake_good_total", 3.0)
+        eng.tick()
+        s = eng.summary()["slos"]["err_ratio"]
+        # post-restart tips are taken as-is, never as a negative delta
+        assert s["bad"] == 7.0 and s["total"] == 105.0
+
+    def test_histogram_threshold_mode(self):
+        sli = SLI(name="latency", objective=0.9, mode="histogram_threshold",
+                  families=("karpenter_fake_seconds",), threshold=1.0)
+        clk = Clock(0.0)
+        eng, reg = make_engine(clk, [sli])
+        reg.set("karpenter_fake_seconds_count", 0.0)
+        reg.set("karpenter_fake_seconds_bucket", 0.0, (("le", "1.0"),))
+        eng.tick()
+        clk.t = 60.0
+        reg.set("karpenter_fake_seconds_count", 10.0)
+        reg.set("karpenter_fake_seconds_bucket", 8.0, (("le", "1.0"),))
+        eng.tick()
+        s = eng.summary()["slos"]["latency"]
+        # 2 of 10 observations above the bucket bound
+        assert s["bad"] == 2.0 and s["total"] == 10.0
+        assert s["budget_remaining"] == -1.0   # 20% bad vs 10% budget
+
+    def test_gauge_uptime_absent_series_is_healthy(self):
+        sli = SLI(name="rung", objective=0.9, mode="gauge_uptime",
+                  families=("karpenter_fake_rung",), max_value=2.0)
+        clk = Clock(0.0)
+        eng, reg = make_engine(clk, [sli])
+        eng.tick()                         # gauge never set: healthy
+        clk.t = 60.0
+        reg.set("karpenter_fake_rung", 2.0)
+        eng.tick()                         # at the ceiling: healthy
+        clk.t = 120.0
+        reg.set("karpenter_fake_rung", 3.0)
+        eng.tick()                         # above: one bad evaluation
+        s = eng.summary()["slos"]["rung"]
+        assert s["bad"] == 1.0 and s["total"] == 3.0
+
+    def test_burn_spike_yields_exactly_one_bundle(self, tmp_path):
+        """A sustained all-errors spike burns both windows of both pairs
+        on every evaluation for ten minutes — the activation edge plus
+        the bus's per-kind dedup fold the whole episode into exactly one
+        `slo_burn` forensic bundle."""
+        clk = Clock(0.0)
+        reg = FakeRegistry()
+        fr = FlightRecorder(clk, registry=reg, cadence_s=30.0,
+                            dirpath=str(tmp_path))
+        fr.arm()
+        eng = SLOEngine(clk, registry=reg, ring=fr.ring, slis=(RATIO_SLI,),
+                        eval_cadence_s=60.0)
+        reg.set("karpenter_fake_bad_total", 0.0)
+        reg.set("karpenter_fake_good_total", 0.0)
+        for step in range(21):             # t = 0..600 in 30s steps
+            clk.t = step * 30.0
+            reg.set("karpenter_fake_bad_total", float(step * 5))
+            fr.sample()
+            eng.tick()
+        burns = [b for b in fr.bundles if b["kind"] == "slo_burn"]
+        assert len(burns) == 1
+        s = eng.summary()["slos"]["err_ratio"]
+        assert s["alerting"] is True and s["alerts"] == 1
+        # 100% errors against a 1% budget: burn rate 100x in every window
+        for _short, _long, thr in BURN_WINDOW_PAIRS:
+            assert all(v > thr for v in s["burn"].values())
+
+    def test_healthy_run_never_alerts(self):
+        clk = Clock(0.0)
+        eng, reg = make_engine(clk, [RATIO_SLI])
+        seen = []
+        BUS.arm(lambda k, d, t: seen.append(k), clk)
+        for step in range(21):
+            clk.t = step * 30.0
+            reg.set("karpenter_fake_good_total", float(step * 5))
+            eng.tick()
+        s = eng.summary()["slos"]["err_ratio"]
+        assert s["alerts"] == 0 and not s["alerting"]
+        assert s["budget_remaining"] == 1.0
+        assert seen == []
+
+    def test_snapshot_restore_carries_budgets_and_tips(self):
+        clk = Clock(0.0)
+        eng, reg = make_engine(clk, [RATIO_SLI])
+        reg.set("karpenter_fake_bad_total", 0.0)
+        eng.tick()
+        clk.t = 60.0
+        reg.set("karpenter_fake_bad_total", 5.0)
+        reg.set("karpenter_fake_good_total", 95.0)
+        eng.tick()
+        state = json.loads(json.dumps(eng.snapshot_state()))
+
+        # successor process: fresh engine, zeroed registry (kill -9)
+        eng2, reg2 = make_engine(clk, [RATIO_SLI])
+        eng2.restore_state(state)
+        assert eng2.evals == 2
+        clk.t = 120.0
+        reg2.set("karpenter_fake_bad_total", 1.0)
+        reg2.set("karpenter_fake_good_total", 9.0)
+        eng2.tick()
+        s = eng2.summary()["slos"]["err_ratio"]
+        # pre-restart history carried once, post-restart tips added once
+        assert s["bad"] == 6.0 and s["total"] == 110.0
+
+
+# ---------------------------------------------------------------------------
+# cost ledger
+# ---------------------------------------------------------------------------
+
+class TestCostLedger:
+    def test_disarmed_hooks_are_noops(self):
+        assert LEDGER.enabled is False
+        assert LEDGER.record_launch("i-x", nodepool="p", at=0.0) is False
+        assert LEDGER.record_close("i-x", at=1.0) is False
+        assert LEDGER.record_reservation(nodepool="p", expected_dh=1.0,
+                                         at=0.0, ttl_s=60.0) is False
+        assert LEDGER.entries_opened == 0
+
+    def test_decision_context_attribution(self):
+        clk = Clock(0.0)
+        LEDGER.arm(clk)
+        assert LEDGER.current_source() == "provisioning"
+        with LEDGER.decision("consolidation"):
+            assert LEDGER.current_source() == "consolidation"
+            LEDGER.record_launch("i-1", nodepool="p", at=0.0)
+        assert LEDGER.current_source() == "provisioning"
+        LEDGER.record_launch("i-2", nodepool="p", at=0.0)
+        src = {e["id"]: e["decision_source"] for e in LEDGER.recent()}
+        assert src == {"i-1": "consolidation", "i-2": "provisioning"}
+
+    def test_unregistered_decision_source_rejected(self):
+        with pytest.raises(ValueError):
+            LEDGER.decision("vibes")
+        assert "spot_reclaim" in DECISION_SOURCES
+
+    def test_accrual_and_idempotent_close(self):
+        clk = Clock(0.0)
+        LEDGER.arm(clk)
+        LEDGER.record_launch("i-1", nodepool="pool-a", pod_class="t.large",
+                             expected_rate=1.0, realized_rate=2.0, at=0.0)
+        assert LEDGER.record_close("i-1", at=1800.0,
+                                   reason="consolidation") is True
+        # double close (drain→delete then forced reclaim) is a no-op
+        assert LEDGER.record_close("i-1", at=3600.0) is False
+        out = LEDGER.summary(3600.0)
+        slot = out["by_decision_source"]["provisioning"]
+        assert slot == {"expected_dh": 0.5, "realized_dh": 1.0, "entries": 1}
+        assert out["by_nodepool"]["pool-a"]["realized_dh"] == 1.0
+        assert out["entries_opened"] == 1 and out["entries_closed"] == 1
+
+    def test_open_entries_accrue_to_now(self):
+        clk = Clock(0.0)
+        LEDGER.arm(clk)
+        LEDGER.record_launch("i-1", nodepool="p", expected_rate=2.0,
+                             realized_rate=2.0, at=0.0)
+        out = LEDGER.summary(1800.0)
+        assert out["open"] == 1
+        assert out["by_decision_source"]["provisioning"]["realized_dh"] == 1.0
+
+    def test_reservations_stay_out_of_capacity_sums(self):
+        clk = Clock(0.0)
+        LEDGER.arm(clk)
+        LEDGER.record_reservation(nodepool="p", expected_dh=0.75, at=0.0,
+                                  ttl_s=600.0)
+        out = LEDGER.summary(600.0)
+        assert out["headroom_reservations"] == {"count": 1,
+                                                "expected_dh": 0.75}
+        # an annotation, not capacity: no per-source/per-pool row
+        assert "headroom" not in out["by_decision_source"]
+        assert out["by_nodepool"] == {}
+
+    def test_drift_edge_publishes_one_cost_drift(self):
+        clk = Clock(0.0)
+        seen = []
+        BUS.arm(lambda k, d, t: seen.append((k, d)), clk)
+        LEDGER.arm(clk, drift_threshold=0.15)
+        for i in range(4):
+            LEDGER.record_launch(f"i-{i}", nodepool="pool-a",
+                                 expected_rate=1.0, realized_rate=1.3,
+                                 at=float(i))
+        for i in range(4):
+            clk.t = 3600.0 + i
+            LEDGER.record_close(f"i-{i}", at=clk.t)
+        # drift 0.3 > 0.15 crosses min-entries at the third close; the
+        # fourth close keeps it active without re-publishing
+        drifts = [d for k, d in seen if k == "cost_drift"]
+        assert len(drifts) == 1 and LEDGER.drift_alerts == 1
+        assert drifts[0]["nodepool"] == "pool-a"
+        assert drifts[0]["drift"] == pytest.approx(0.3, abs=1e-6)
+        assert LEDGER.summary(clk.t)["by_nodepool"]["pool-a"]["drift"] == \
+            pytest.approx(0.3, abs=1e-6)
+
+    def test_healthy_rates_never_drift(self):
+        clk = Clock(0.0)
+        seen = []
+        BUS.arm(lambda k, d, t: seen.append(k), clk)
+        LEDGER.arm(clk)
+        for i in range(5):
+            LEDGER.record_launch(f"i-{i}", nodepool="p", expected_rate=1.0,
+                                 realized_rate=1.0, at=0.0)
+            LEDGER.record_close(f"i-{i}", at=600.0)
+        assert "cost_drift" not in seen and LEDGER.drift_alerts == 0
+
+    def test_restart_dedup_and_state_carry(self):
+        clk = Clock(0.0)
+        LEDGER.arm(clk)
+        LEDGER.record_launch("i-a", nodepool="p", expected_rate=1.0,
+                             realized_rate=1.0, at=0.0)
+        LEDGER.record_launch("i-b", nodepool="p", expected_rate=1.0,
+                             realized_rate=1.0, at=0.0)
+        LEDGER.record_close("i-a", at=600.0)
+        state = json.loads(json.dumps(LEDGER.snapshot_state()))
+
+        LEDGER.disarm()                    # kill -9
+        LEDGER.arm(clk)
+        LEDGER.restore_state(state)
+        # rehydration replays the launch hooks: both ids are deduped
+        assert LEDGER.record_launch("i-a", nodepool="p", at=700.0) is False
+        assert LEDGER.record_launch("i-b", nodepool="p", at=700.0) is False
+        assert LEDGER.entries_opened == 2 and LEDGER.entries_closed == 1
+        # the open entry survived and still closes exactly once
+        assert LEDGER.record_close("i-b", at=1200.0) is True
+        assert LEDGER.record_close("i-b", at=1200.0) is False
+        assert LEDGER.record_launch("i-c", nodepool="p", at=1300.0) is True
+
+    def test_fresh_ledger_is_isolated(self):
+        lg = CostLedger()
+        lg.arm(Clock(0.0))
+        lg.record_launch("i-1", nodepool="p", at=0.0)
+        assert lg.entries_opened == 1 and LEDGER.entries_opened == 0
+        assert current_trace_id() == ""
+
+
+# ---------------------------------------------------------------------------
+# manager wiring + chaos × restart drill
+# ---------------------------------------------------------------------------
+
+class TestManagerWiring:
+    @staticmethod
+    def _stack(clock, snap_path="", gates=(), cloud=None):
+        from karpenter_tpu.catalog.generate import generate_catalog
+        from karpenter_tpu.cloud.fake import (ImageInfo, SecurityGroupInfo,
+                                              SubnetInfo)
+        from karpenter_tpu.operator import (ControllerManager, Operator,
+                                            Options, build_controllers)
+        opts = Options(snapshot_path=snap_path, interruption_queue="q")
+        for g in gates:
+            opts.feature_gates[g] = True
+        op = Operator(opts, cloud=cloud, catalog=generate_catalog(10),
+                      clock=clock)
+        op.cloud.subnets = [SubnetInfo("s-a", "zone-a", 10_000, {}),
+                            SubnetInfo("s-b", "zone-b", 10_000, {})]
+        op.cloud.security_groups = [SecurityGroupInfo("sg", "nodes", {})]
+        op.cloud.images = [ImageInfo("img-1", "std", "amd64", 1.0)]
+        op.params.parameters = {
+            "/karpenter-tpu/images/standard/1.28/amd64/latest": "img-1"}
+        mgr = ControllerManager(op, build_controllers(op), clock=clock)
+        return op, mgr
+
+    @staticmethod
+    def _pods(n):
+        from karpenter_tpu.api.objects import Pod
+        from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+        return [Pod(requests=ResourceList({CPU: 500, MEMORY: 512 * 2**20}))
+                for _ in range(n)]
+
+    def test_gate_off_means_no_engine_no_ledger(self):
+        clk = [1000.0]
+        op, mgr = self._stack(lambda: clk[0])
+        assert mgr.slo is None
+        assert LEDGER.enabled is False
+        assert mgr.slo_snapshot_state() is None
+        assert mgr.ledger_snapshot_state() is None
+
+    def test_gate_on_arms_engine_and_ledger(self):
+        clk = [1000.0]
+        op, mgr = self._stack(lambda: clk[0], gates=("SLOEngine",))
+        assert mgr.slo is not None and mgr.slo._owns_ring
+        assert LEDGER.enabled is True
+        mgr.tick()
+        assert len(mgr.slo.ring) == 1      # sampled from the manager tick
+
+    def test_flight_recorder_shares_one_ring(self):
+        clk = [1000.0]
+        op, mgr = self._stack(lambda: clk[0],
+                              gates=("SLOEngine", "FlightRecorder"))
+        assert mgr.flight is not None and mgr.slo is not None
+        assert mgr.slo.ring is mgr.flight.ring
+        assert not mgr.slo._owns_ring
+
+    def test_chaos_restart_ledger_and_budgets_survive(self, tmp_path):
+        """Kill -9 mid-run: the successor restores the ledger and SLO
+        budgets from the snapshot, rehydrated launch replays are deduped
+        (no double-counted entries), and budget history is carried
+        exactly once."""
+        from karpenter_tpu.state.snapshot import (load_sections,
+                                                  restore_snapshot,
+                                                  write_snapshot)
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        gates = ("WarmRestart", "SLOEngine")
+        op, mgr = self._stack(lambda: clk[0], path, gates)
+        op.cluster.add_pods(self._pods(6))
+        mgr.tick()
+        clk[0] += 61.0
+        mgr.tick()
+        assert op.cluster.nodes and not op.cluster.pending_pods()
+        opened = LEDGER.entries_opened
+        assert opened >= 1                 # every launch was ledgered
+        launched_ids = [e["id"] for e in LEDGER.recent()]
+        pre_summary = LEDGER.summary(clk[0])
+        pre_evals = mgr.slo.evals
+        assert pre_evals >= 1
+        pre_budgets = mgr.slo.summary()["slos"]
+        assert write_snapshot(path, op, mgr)
+        sections, status = load_sections(path)
+        assert status == "ok"
+        assert "slo" in sections and "ledger" in sections
+
+        LEDGER.disarm()                    # kill -9: in-memory state gone
+        op2, mgr2 = self._stack(lambda: clk[0], path, gates,
+                                cloud=op.raw_cloud)
+        assert restore_snapshot(path, op2, mgr2) == "restored"
+        assert LEDGER.entries_opened == opened
+        assert LEDGER.summary(clk[0]) == pre_summary
+        # the cloud's rehydrated instances must not re-open entries
+        for iid in launched_ids:
+            assert LEDGER.record_launch(iid, nodepool="x", at=clk[0]) is False
+        assert LEDGER.entries_opened == opened
+        # budget history carried exactly once, eval cursor intact
+        assert mgr2.slo.evals == pre_evals
+        assert mgr2.slo.summary()["slos"] == pre_budgets
+        # the successor keeps evaluating without a counter-reset spike
+        clk[0] += 61.0
+        mgr2.tick()
+        post = mgr2.slo.summary()["slos"]
+        for name, before in pre_budgets.items():
+            assert post[name]["total"] >= before["total"]
+
+    def test_gate_off_snapshot_has_no_obs_sections(self, tmp_path):
+        from karpenter_tpu.state.snapshot import load_sections, write_snapshot
+        clk = [1000.0]
+        path = str(tmp_path / "snap.bin")
+        op, mgr = self._stack(lambda: clk[0], path, ("WarmRestart",))
+        op.cluster.add_pods(self._pods(2))
+        mgr.tick()
+        assert write_snapshot(path, op, mgr)
+        sections, status = load_sections(path)
+        assert status == "ok"
+        assert "slo" not in sections and "ledger" not in sections
+
+
+# ---------------------------------------------------------------------------
+# scenario DSL
+# ---------------------------------------------------------------------------
+
+BASE_DOC = {
+    "name": "t", "duration_s": 600,
+    "workload": [{"kind": "step", "name": "w"}],
+}
+
+
+class TestScenarioSpec:
+    def test_slo_block_parses(self):
+        doc = dict(BASE_DOC)
+        doc["slo"] = {"enabled": True, "eval_cadence_s": 30.0,
+                      "drift_threshold": 0.2}
+        sc = scenario_from_dict(doc)
+        assert sc.slo == SLOSpec(enabled=True, eval_cadence_s=30.0,
+                                 drift_threshold=0.2)
+        sc.validate()
+
+    def test_slo_block_defaults_and_absence(self):
+        assert scenario_from_dict(dict(BASE_DOC)).slo is None
+        doc = dict(BASE_DOC)
+        doc["slo"] = {}
+        assert scenario_from_dict(doc).slo == SLOSpec()
+
+    def test_slo_block_rejects_unknown_and_invalid(self):
+        doc = dict(BASE_DOC)
+        doc["slo"] = {"cadence": 5}
+        with pytest.raises(ValueError):
+            scenario_from_dict(doc)
+        with pytest.raises(ValueError):
+            SLOSpec(eval_cadence_s=0.0).validate()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end sim captures
+# ---------------------------------------------------------------------------
+
+def test_spot_reclaim_storm_gate_on_budgets_and_ledger():
+    """SLOEngine ON over the reclaim storm: the report grows a
+    `slo.budgets` rollup with every registered SLI, a `ledger` section
+    whose per-source $·h attribution sums to the report's own cost
+    integral (within 1%), and per-source/per-pool cost breakdowns."""
+    sc = load_scenario(os.path.join(SCENARIOS, "spot-reclaim-storm.yaml"))
+    run = SimHarness(sc, seed=0, duration_s=7200.0, slo=True).run()
+    rep = json.loads(report_to_json(run.report))
+
+    budgets = rep["slo"]["budgets"]
+    assert budgets["evaluations"] > 0 and budgets["ring_samples"] > 0
+    assert set(budgets["slos"]) == {s.name for s in DEFAULT_SLIS}
+    for s in budgets["slos"].values():
+        assert "budget_remaining" in s and "burn" in s
+
+    led = rep["ledger"]
+    assert led["entries_opened"] >= 1
+    # reclaims closed entries through the forced-delivery path
+    assert led["entries_closed"] >= 1
+    dollar_hours = rep["cost"]["dollar_hours"]
+    for field in ("expected_dh", "realized_dh"):
+        total = sum(v[field] for v in led["by_decision_source"].values())
+        assert total == pytest.approx(dollar_hours, rel=0.01), field
+    # the cost section carries the same attribution
+    assert rep["cost"]["by_decision_source"] == {
+        k: v["realized_dh"] for k, v in led["by_decision_source"].items()}
+    assert rep["cost"]["by_nodepool"] == {
+        k: v["realized_dh"] for k, v in led["by_nodepool"].items()}
+    assert set(led["by_decision_source"]) <= DECISION_SOURCES
+
+
+GOLDEN_CASES = [
+    ("diurnal", "diurnal.yaml", 7200.0),
+    ("spot-reclaim-storm", "spot-reclaim-storm.yaml", 7200.0),
+    ("ice-starvation", "ice-starvation.yaml", 5400.0),
+    ("diurnal-forecast", "diurnal-forecast.yaml", 7200.0),
+    ("spot-reclaim-storm-forecast", "spot-reclaim-storm-forecast.yaml",
+     7200.0),
+    ("steady-state-drip", "steady-state-drip.yaml", 300.0),
+    ("chaos-storm", "chaos-storm.yaml", 5400.0),
+    ("long-soak", "long-soak.yaml", 120.0),
+    ("failover-drill", "failover-drill.yaml", 5400.0),
+]
+
+
+@pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
+                         ids=[c[0] for c in GOLDEN_CASES])
+def test_golden_report_slo_gate_off(name, fname, duration):
+    """SLOEngine defaults OFF and, explicitly off, must leave every
+    canned scenario's report byte-identical — the disarmed ledger is one
+    boolean check and the engine is never constructed."""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration, slo=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"slo=off report for {fname} diverged from {path}: the SLO "
+            f"engine or cost ledger perturbed a run it never armed for")
