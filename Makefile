@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode bench-lp decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke incident-smoke slo-smoke deflake native clean help
+.PHONY: test scale-test lint-analysis benchmark bench-smoke bench-consolidation bench-sim bench-forecast bench-drip bench-megafleet bench-decode bench-lp decode-smoke bench-soak benchmark-interruption trace-demo sim-demo chaos-smoke soak-smoke failover-smoke incident-smoke slo-smoke gang-smoke deflake native clean help
 
 help: ## Show targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | awk -F ':.*## ' '{printf "  %-24s %s\n", $$1, $$2}'
@@ -78,6 +78,10 @@ incident-smoke: ## Replay chaos-storm with the flight recorder armed + run the i
 slo-smoke: ## Replay spot-reclaim-storm with the SLO engine + cost ledger armed + run the SLO suite (docs/observability.md)
 	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/spot-reclaim-storm.yaml --seed 0 --slo > /dev/null
 	$(PYTEST) tests/test_slo.py -q
+
+gang-smoke: ## Replay the gang churn storm (truncated; the scenario's gang block arms the gate) + run the gang suite (docs/gang.md)
+	JAX_PLATFORMS=cpu python -m karpenter_tpu.sim scenarios/gang-churn-storm.yaml --seed 0 --duration 7200 > /dev/null
+	$(PYTEST) tests/test_gang.py -q
 
 deflake: ## Run the suite 5x to shake out order/timing flakes (Makefile:106-109)
 	for i in 1 2 3 4 5; do $(PYTEST) tests/ -q -p no:randomly || exit 1; done

@@ -78,6 +78,7 @@ TRIP_FAMILIES = frozenset({
     "leader_fence_refusals",      # stale fencing epoch refused a mutation
     "degradation_transitions",    # SolverHealth ladder moved
     "decode_transitions",         # DecodeHealth breaker moved
+    "gang_rejections",            # all-or-nothing gang admission rejected
 })
 
 _OB006_EXEMPT_PREFIX = "karpenter_tpu/obs/"

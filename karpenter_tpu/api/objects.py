@@ -79,6 +79,16 @@ class Pod:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     priority: int = 0
+    # gang scheduling (GangScheduling gate, ops/gang.py): pods sharing a
+    # non-empty gang_name form an all-or-nothing unit of gang_size members
+    # — every member binds in one solve within one topology domain
+    # (gang_topology: "zone" | "hostname") or none do.  gang_tier is the
+    # preemption tier: a rejected higher-tier gang may evict bound pods of
+    # strictly lower tiers.  Defaults leave non-gang pods untouched.
+    gang_name: str = ""
+    gang_size: int = 0
+    gang_tier: int = 0
+    gang_topology: str = "zone"
     deletion_cost: int = 0               # pod-deletion-cost annotation analog
     owner_kind: str = "ReplicaSet"       # "" == ownerless (blocks consolidation)
     node_name: str = ""                  # bound node ("" == pending)

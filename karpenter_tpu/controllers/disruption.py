@@ -185,7 +185,8 @@ class DisruptionController:
                  batched_sweep: bool = True,
                  sharded_solve: bool = False,
                  health=None,
-                 watchdog_timeout_s: float = 0.0):
+                 watchdog_timeout_s: float = 0.0,
+                 gang_source: Optional[Callable] = None):
         from ..utils.events import Recorder
         self.provider = provider
         self.cluster = cluster
@@ -208,6 +209,11 @@ class DisruptionController:
         # deadline (utils/watchdog.py); None/0 keep the legacy direct path
         self.health = health
         self.watchdog_timeout_s = watchdog_timeout_s
+        # GangScheduling: callable draining the provisioner's queued
+        # preemption plans (Provisioner.take_preemption_plan); one plan
+        # executes per tick, victims unbinding to pending exactly like
+        # consolidation reschedules.  None == gate off.
+        self.gang_source = gang_source
         self._empty_since: Dict[str, float] = {}  # node → first seen empty
         self._arena_cache = None  # (fingerprint, SimulationArena)
         # (mutation_epoch, catalog_key, candidates, fingerprint) — skips the
@@ -528,6 +534,16 @@ class DisruptionController:
             eligible.set(len(underutil), {"method": "consolidation"})
             csp.annotate(candidates=len(cands), expired=len(expired),
                          drifted=len(drifted), empty=len(empty))
+
+        # 0. gang preemption (GangScheduling): a waiting higher-tier gang
+        #    outranks bound lower-tier pods; one queued plan executes per
+        #    tick, ahead of every other method — admission latency for
+        #    tiered gangs is the whole point of the cascade
+        if self.gang_source is not None:
+            plan = self.gang_source()
+            if plan is not None:
+                return self._execute_preemption(plan)
+
         if not cands:
             return DisruptionResult()
 
@@ -577,6 +593,35 @@ class DisruptionController:
         if action:
             return self.execute(action)
         return DisruptionResult()
+
+    def _execute_preemption(self, plan) -> DisruptionResult:
+        """Evict one gang preemption plan's victims: each unbinds to
+        pending (the consolidation-reschedule motion — the pod re-solves
+        next provisioning round, the node keeps running for its other
+        pods).  Victims that moved or exited since planning are skipped;
+        if the freed room proves insufficient the next solve queues a
+        deeper plan down the cascade."""
+        evicted = 0
+        for v in plan.victims:
+            node = self.cluster.nodes.get(v.node)
+            if node is None:
+                continue
+            pod = next((p for p in node.pods if p.uid == v.uid), None)
+            if pod is None:
+                continue
+            self.cluster.unbind_pod(pod)
+            metrics.gang_preemptions().inc({"tier": str(v.tier)})
+            self.recorder.publish(Event(
+                kind="Pod", name=pod.name, reason="GangPreempted",
+                message=(f"evicted for gang {plan.gang}: tier {v.tier} "
+                         f"yields to tier {plan.tier}"),
+                type="Warning"))
+            evicted += 1
+        log.info("gang preemption for %s: evicted %d/%d victims in %s %r",
+                 plan.gang, evicted, len(plan.victims), plan.topology,
+                 plan.domain)
+        return DisruptionResult(action=Action(kind="preempt", reason="gang",
+                                              candidates=[]))
 
     def _replace_or_delete(self, targets: List[Candidate], reason: str) -> Optional[Action]:
         """Expiration/drift disruption: pods must land somewhere — on the

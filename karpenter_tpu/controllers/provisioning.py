@@ -37,7 +37,10 @@ from ..ops.constraints import (MAX_LEVEL, find_batch_topology_violations,
 from ..ops.classpack import solve_classpack
 from ..ops.ffd import (NATIVE_CUTOVER_ROWS, NodeDecision, PackingResult,
                        solve_ffd)
+from ..ops.gang import (INCOMPLETE, PARTIAL, GangRegistry, PreemptionPlan,
+                        enforce_gangs, plan_preemption)
 from ..ops.tensorize import Problem, tensorize
+from ..obs.incidents import publish_incident
 from ..parallel.driver import maybe_solve_partitioned
 from ..state.cluster import Cluster
 from ..utils import metrics, tracing
@@ -158,7 +161,8 @@ class Provisioner:
                  device_decode: bool = False,
                  decode_health=None,
                  device_lp: bool = False,
-                 lp_health=None):
+                 lp_health=None,
+                 gang_scheduling: bool = False):
         self.provider = provider
         self.cluster = cluster
         self.nodepools = pool_view(nodepools)
@@ -217,6 +221,14 @@ class Provisioner:
             self._classpack = functools.partial(
                 self._classpack, device_decode=True,
                 decode_health=decode_health)
+        # GangScheduling feature gate (ops/gang.py): post-solve
+        # all-or-nothing enforcement over every packing, plus the
+        # preemption-plan queue the DisruptionController drains one plan
+        # per tick.  The registry is the snapshot-carried admission ledger
+        # (state/snapshot.py section "gang"); None == gate off.
+        self.gang_scheduling = bool(gang_scheduling)
+        self.gang_registry = GangRegistry() if self.gang_scheduling else None
+        self.gang_preemption_plans: Dict[str, PreemptionPlan] = {}
 
     def _pick_solver(self, problem: Problem, n_existing: int = 0):
         """The flagship class-granular kernel IS the provisioning hot path —
@@ -407,6 +419,11 @@ class Provisioner:
                     existing = gathered  # (node_list, alloc, used, compat)
                 result = self._pack_supervised(problem, psp, existing)
                 result._existing_nodes = existing[0] if existing else []
+                if self.gang_scheduling and problem.class_gang is not None:
+                    # all-or-nothing admission happens HERE, before the
+                    # plan is visible to any bind/launch consumer — no
+                    # partial gang ever reaches claim_requests
+                    self._enforce_gangs(problem, result, node_view)
                 psp.annotate(scheduled=result.scheduled_count,
                              unschedulable=len(result.unschedulable))
             if best is None or result.scheduled_count > best[1].scheduled_count:
@@ -417,6 +434,61 @@ class Provisioner:
                 log.info("relaxing soft constraints to level %d (%d unschedulable)",
                          level + 1, len(result.unschedulable))
         return best
+
+    def _enforce_gangs(self, problem, result, node_view) -> None:
+        """Gang admission funnel (GangScheduling): audit + strip rejected
+        gangs from the packing, count the verdicts, and queue preemption
+        plans for outranked capacity.  Rejections publish a `gang_rejected`
+        incident in the same function as the counter inc (graftlint
+        OB006)."""
+        t0 = self.clock()
+        audits = enforce_gangs(problem, result, result._existing_nodes,
+                               registry=self.gang_registry,
+                               cluster_nodes=node_view)
+        partial = 0
+        for a in audits:
+            if a.admitted:
+                metrics.gang_admissions().inc({"tier": str(a.gang.tier)})
+                # a gang that now fits no longer needs its queued evictions
+                self.gang_preemption_plans.pop(a.gang.name, None)
+                continue
+            metrics.gang_rejections().inc({"reason": a.reason})
+            publish_incident("gang_rejected",
+                             {"gang": a.gang.name, "reason": a.reason,
+                              "placed": len(a.placed),
+                              "arrived": len(a.members),
+                              "size": a.gang.size, "tier": a.gang.tier})
+            if a.reason == PARTIAL:
+                partial += 1
+            # priority cascade: a rejected gang with standing (every
+            # member present — pending or still bound — and tier > 0)
+            # simulates evicting strictly-lower-tier pods; the
+            # DisruptionController executes one plan per tick and the
+            # REAL solver re-admits the gang on a later round.  Bound
+            # residents pin the domain: stragglers must rejoin where the
+            # rest of the gang lives, or they'd come back a straddle.
+            if (a.gang.tier > 0 and a.reason != INCOMPLETE
+                    and a.gang.name not in self.gang_preemption_plans):
+                plan = plan_preemption(
+                    a.gang, [problem.pods[i].requests for i in a.members],
+                    node_view, pin_domains=a.bound_domains)
+                if plan is not None and plan.victims:
+                    self.gang_preemption_plans[a.gang.name] = plan
+        metrics.gang_partial_placeable().set(partial)
+        metrics.gang_solve_duration().observe(max(0.0, self.clock() - t0))
+
+    def take_preemption_plan(self) -> Optional[PreemptionPlan]:
+        """Pop the oldest queued gang preemption plan (FIFO — insertion
+        order is rejection order).  The DisruptionController's per-tick
+        drain; None when the queue is empty or the gate is off."""
+        if not self.gang_preemption_plans:
+            return None
+        name = next(iter(self.gang_preemption_plans))
+        plan = self.gang_preemption_plans.pop(name)
+        if self.gang_registry is not None:
+            self.gang_registry.record_preemption(plan.gang,
+                                                 len(plan.victims))
+        return plan
 
     def provision(self, pods: Optional[Sequence[Pod]] = None,
                   max_retries: int = 1) -> ProvisioningResult:

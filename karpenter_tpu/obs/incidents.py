@@ -40,6 +40,7 @@ INCIDENT_KINDS = frozenset({
     "leader_loss",         # leadership lost mid-term (deposed, not released)
     "slo_burn",            # error budget burning in both windows of a pair
     "cost_drift",          # ledger expected-vs-realized $·h drift per pool
+    "gang_rejected",       # all-or-nothing gang admission rejected a gang
 })
 
 

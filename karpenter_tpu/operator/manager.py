@@ -802,6 +802,24 @@ class ControllerManager:
         from ..obs.ledger import LEDGER
         LEDGER.restore_state(data)
 
+    def gang_snapshot_state(self) -> Optional[Dict]:
+        """Gang admission registry for the WarmRestart snapshot (None when
+        the GangScheduling gate is off).  The registry is the proof
+        surface for the no-half-admission invariant: every gang is either
+        fully admitted or fully pending at the checkpoint, and the
+        restored operator starts from exactly that ledger."""
+        prov = self.controllers.get("provisioning")
+        reg = getattr(prov, "gang_registry", None)
+        if reg is None:
+            return None
+        return reg.snapshot_state()
+
+    def gang_restore_state(self, data: Dict) -> None:
+        prov = self.controllers.get("provisioning")
+        reg = getattr(prov, "gang_registry", None)
+        if reg is not None and data:
+            reg.restore_state(data)
+
     def ha_restore_state(self, data: Dict) -> None:
         """Restore the HA counters (phase itself is NOT restored: the
         restoring process is walking its own readiness ladder and must

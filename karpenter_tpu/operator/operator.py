@@ -390,7 +390,8 @@ def build_controllers(op: Operator) -> Dict[str, object]:
         device_decode=op.options.gate("DeviceDecode"),
         decode_health=decode_health,
         device_lp=device_lp,
-        lp_health=lp_health)
+        lp_health=lp_health,
+        gang_scheduling=op.options.gate("GangScheduling"))
     terminator = TerminationController(op.cloud_provider, op.cluster,
                                        clock=op.clock)
     out: Dict[str, object] = {
@@ -404,7 +405,12 @@ def build_controllers(op: Operator) -> Dict[str, object]:
             recorder=op.recorder,
             sharded_solve=op.options.gate("ShardedSolve"),
             health=health,
-            watchdog_timeout_s=solve_timeout),
+            watchdog_timeout_s=solve_timeout,
+            # gang preemption plans flow provisioner → disruption: the
+            # admission funnel queues them, the disruption tick executes
+            # one per round (GangScheduling gate)
+            gang_source=(provisioner.take_preemption_plan
+                         if op.options.gate("GangScheduling") else None)),
         "lifecycle": LifecycleController(
             op.cloud_provider, op.cluster, nodepools=op.nodepools,
             recorder=op.recorder, clock=op.clock),

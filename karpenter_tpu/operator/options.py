@@ -122,6 +122,14 @@ class Options:
     # `cost_drift` incidents through the same bus the flight recorder
     # captures.  Off by default; enable with --slo-engine or
     # --feature-gates SLOEngine=true.  Knobs below.
+    # GangScheduling: gang / topology-aware scheduling (ops/gang.py,
+    # docs/gang.md) — pods sharing a gang id admit all-or-nothing within
+    # one topology domain (zone or hostname); rejected higher-tier gangs
+    # queue preemption plans that evict strictly-lower-tier pods through
+    # the DisruptionController, cascade-ordered by tier then disruption
+    # cost.  Rejections publish `gang_rejected` incidents and surface
+    # "gang partially placeable: k/n" provenance.  Off by default; enable
+    # with --gang-scheduling or --feature-gates GangScheduling=true.
     feature_gates: Dict[str, bool] = field(
         default_factory=lambda: {"Drift": True, "LPGuide": True,
                                  "LPRefinery": False, "Forecast": False,
@@ -133,7 +141,8 @@ class Options:
                                  "DeviceLP": False,
                                  "HAFailover": False,
                                  "FlightRecorder": False,
-                                 "SLOEngine": False})
+                                 "SLOEngine": False,
+                                 "GangScheduling": False})
     # forecast/headroom knobs (used only with the Forecast gate on)
     forecast_cadence_s: float = 30.0       # HeadroomController reconcile cadence
     forecast_horizon_s: float = 900.0      # forecast window length
@@ -395,6 +404,12 @@ class Options:
                        default=env.get("ledger_drift_threshold", 0.15),
                        help="relative expected-vs-realized $·h drift per "
                             "nodepool that trips a cost_drift incident")
+        p.add_argument("--gang-scheduling", action="store_true",
+                       default=False,
+                       help="all-or-nothing gang admission within one "
+                            "topology domain + priority-tier preemption "
+                            "(shorthand for --feature-gates "
+                            "GangScheduling=true)")
         p.add_argument("--feature-gates", default="",
                        help="comma list Gate=true|false")
         ns = p.parse_args(argv)
@@ -472,6 +487,8 @@ class Options:
             opts.feature_gates["FlightRecorder"] = True
         if ns.slo_engine:
             opts.feature_gates["SLOEngine"] = True
+        if ns.gang_scheduling:
+            opts.feature_gates["GangScheduling"] = True
         _parse_kv_list(ns.feature_gates, opts.feature_gates,
                        cast=lambda v: v.lower() != "false")
         return opts

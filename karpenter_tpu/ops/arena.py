@@ -80,6 +80,8 @@ class ClusterArena:
         self._top = 0                           # guarded-by: caller(state_lock)
         self._rid_of: Dict[tuple, int] = {}     # guarded-by: caller(state_lock)
         self._reps: List[Pod] = []              # guarded-by: caller(state_lock)
+        # node name → {gang name → resident member count}; see _fill_used
+        self.gang_residents: Dict[str, Dict[str, int]] = {}  # guarded-by: caller(state_lock)
         # monotone per-delta counter: consumers (SimulationArena faces,
         # disruption's lazy re-fingerprint) compare it to decide staleness
         # without walking the object graph
@@ -167,6 +169,18 @@ class ClusterArena:
         req[PODS] = len(node.pods)
         self.slab_used[slot] = req.to_vector(self._axes, self._scales,
                                              round_up=True)
+        # gang-resident index (GangScheduling, ops/gang.py): node → gang →
+        # member count, maintained by the same delta events that refresh
+        # `used` rows.  Advisory — NOT part of snapshot_state; it re-derives
+        # as rows refresh (rebuild() repopulates it in full).
+        res: Dict[str, int] = {}
+        for p in node.pods:
+            if p.gang_name:
+                res[p.gang_name] = res.get(p.gang_name, 0) + 1
+        if res:
+            self.gang_residents[node.name] = res
+        else:
+            self.gang_residents.pop(node.name, None)
 
     # ---- class registry ---------------------------------------------------
     def _ensure_classes(self, reps: Sequence[Pod],  # guarded-by: caller(state_lock)
@@ -223,6 +237,7 @@ class ClusterArena:
         self._note_delta("node_add")
 
     def apply_node_remove(self, name: str):  # guarded-by: caller(state_lock)
+        self.gang_residents.pop(name, None)
         slot = self._slot_of.pop(name, None)
         if slot is None:
             return
@@ -293,6 +308,7 @@ class ClusterArena:
         run first so their slots recycle for same-tick adds."""
         with tracing.span("arena.ingest_flush"):
             for name in removed:
+                self.gang_residents.pop(name, None)
                 slot = self._slot_of.pop(name, None)
                 if slot is None:
                     continue
@@ -433,6 +449,7 @@ class ClusterArena:
             self._node_at = [None] * self.slab_alloc.shape[0]
             self._slot_of = {}
             self._free = []
+            self.gang_residents = {}
             self._top = E
             for slot, node in enumerate(nodes):
                 self._slot_of[node.name] = slot
@@ -444,6 +461,12 @@ class ClusterArena:
             self._note_delta("rebuild")
 
     # ---- the consumer surface ---------------------------------------------
+    def gangs_on(self, node_name: str) -> Dict[str, int]:  # guarded-by: caller(state_lock)
+        """Gang name → resident member count on one node (GangScheduling):
+        the delta-maintained index preemption planning and tests read
+        instead of walking every node's pod list."""
+        return dict(self.gang_residents.get(node_name, ()))
+
     def gather(self, pod_classes: Sequence[Pod],
                axes: Tuple[str, ...] = DEFAULT_AXES,
                exclude: Sequence[str] = (),

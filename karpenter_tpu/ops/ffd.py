@@ -142,6 +142,37 @@ class PackingResult:
         return (sum(len(n.pod_indices) for n in self.nodes)
                 + len(self.existing_assignments))
 
+    def strip_pods(self, pod_indices, pods=None) -> None:
+        """Remove pods from the plan in place: they leave their node
+        decisions / existing slots and land in `unschedulable`.  Decisions
+        left empty are dropped (their node is never launched) and
+        `total_price` re-sums over the survivors.  This is how gang
+        enforcement (ops/gang.py) takes a rejected gang out of the plan
+        wholesale — no partial bind ever reaches claim_requests.  `pods`
+        (the Problem's pod list) lets per-decision `used` shrink with the
+        departures so downstream claim sizing stays honest."""
+        drop = {int(i) for i in pod_indices}
+        if not drop:
+            return
+        kept = []
+        for dec in self.nodes:
+            removed = [i for i in dec.pod_indices if int(i) in drop]
+            if removed:
+                dec.pod_indices = [i for i in dec.pod_indices
+                                   if int(i) not in drop]
+                if dec.used is not None and pods is not None:
+                    for i in removed:
+                        dec.used = dec.used - pods[i].requests
+                    dec.used = dec.used.clamp_nonnegative()
+            if dec.pod_indices:
+                kept.append(dec)
+        self.nodes = kept
+        for i in [i for i in self.existing_assignments if int(i) in drop]:
+            del self.existing_assignments[i]
+        self.unschedulable = sorted(
+            {int(i) for i in self.unschedulable} | drop)
+        self.total_price = float(sum(d.option.price for d in self.nodes))
+
 
 @dataclass
 class SweepResult:

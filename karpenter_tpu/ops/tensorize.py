@@ -50,6 +50,16 @@ class LaunchOption:
     weight_rank: int = 0  # 0 == highest-weight pool (pool precedence)
 
 
+@dataclass(frozen=True)
+class GangInfo:
+    """One all-or-nothing gang observed in a batch (ops/gang.py): every
+    member binds in one solve within one topology domain, or none do."""
+    name: str
+    size: int                 # declared member count (arrived may be less)
+    tier: int                 # preemption tier (higher evicts lower)
+    topology: str = "zone"    # domain granularity: "zone" | "hostname"
+
+
 @dataclass
 class Problem:
     """Dense scheduling problem. All arrays are numpy on the host; kernels
@@ -74,6 +84,12 @@ class Problem:
     # capacity-type vocabulary; on-demand=0, spot=1 in the standard catalog)
     zones: List[str] = field(default_factory=list)
     pods: List[Pod] = field(default_factory=list)
+    # gang columns (GangScheduling): class → index into `gangs` (-1 = not
+    # in a gang).  Gang members may span several classes (heterogeneous
+    # specs); `None` class_gang means "no gang pods in this batch" and
+    # every consumer short-circuits.
+    class_gang: np.ndarray = None   # C int32, -1 == non-gang
+    gangs: List[GangInfo] = field(default_factory=list)
     # per-axis quantity scales the dense arrays were lowered with (byte axes
     # divide to MiB so int32 kernel math can't overflow); decode must invert
     # with THESE, not DEFAULT_SCALES — extra axes may carry their own scale
@@ -99,7 +115,25 @@ class Problem:
                 else np.ones(len(self.axes), np.float32))
         norm = np.where(norm > 0, norm, 1.0)
         size = (self.class_requests / norm).max(axis=1)
-        return np.argsort(-size, kind="stable")
+        order = np.argsort(-size, kind="stable")
+        if self.class_gang is not None:
+            # gang members pack adjacently (at the rank of the gang's
+            # largest class) so one scan sees the whole gang together —
+            # the no-gang path above is byte-identical to the pre-gang key
+            gang_slot: Dict[int, int] = {}
+            groups: List[List[int]] = []
+            for ci in order.tolist():
+                g = int(self.class_gang[ci])
+                if g < 0:
+                    groups.append([ci])
+                elif g in gang_slot:
+                    groups[gang_slot[g]].append(ci)
+                else:
+                    gang_slot[g] = len(groups)
+                    groups.append([ci])
+            order = np.asarray([ci for grp in groups for ci in grp],
+                               order.dtype)
+        return order
 
     @property
     def num_options(self) -> int:
@@ -178,6 +212,12 @@ def _class_key(pod: Pod) -> tuple:
                for a in pa]) if pa else (),
         tuple(sorted(lab.items())) if lab else (),
         d["namespace"],
+        # gang members must never merge into non-gang classes (and gangs
+        # must not merge with each other): the gang spec is part of the
+        # scheduling-relevant identity.  Non-gang pods keep () so every
+        # pre-gang key is unchanged in content.
+        ((d["gang_name"], d["gang_size"], d["gang_tier"],
+          d["gang_topology"]) if d["gang_name"] else ()),
     )
     d["_ckey"] = k
     return k
@@ -547,6 +587,27 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
         class_requests[ci] = req.to_vector(axes, scales, round_up=True)
         class_compat[ci] = side.compat_row(rep)
 
+    # gang columns: class → gang index in first-appearance order (the same
+    # deterministic order classes themselves use).  The gang spec rides on
+    # the class key, so one gang's heterogeneous members land in distinct
+    # classes that all point at one GangInfo row.
+    class_gang = None
+    gangs: List[GangInfo] = []
+    if any(rep.gang_name for rep in reps):
+        class_gang = np.full(C, -1, np.int32)
+        gang_of: Dict[str, int] = {}
+        for ci, rep in enumerate(reps):
+            if not rep.gang_name:
+                continue
+            gi = gang_of.get(rep.gang_name)
+            if gi is None:
+                gi = gang_of[rep.gang_name] = len(gangs)
+                gangs.append(GangInfo(name=rep.gang_name,
+                                      size=int(rep.gang_size),
+                                      tier=int(rep.gang_tier),
+                                      topology=rep.gang_topology or "zone"))
+            class_gang[ci] = gi
+
     return Problem(
         axes=axes,
         class_requests=class_requests,
@@ -563,6 +624,8 @@ def tensorize(pods: Sequence[Pod], catalog: Sequence[InstanceType],
         zones=side.zones,
         pods=list(pods),
         scales=scales,
+        class_gang=class_gang,
+        gangs=gangs,
     )
 
 

@@ -129,6 +129,16 @@ def plan_partition(problem: Problem, n_shards: int,
     else:
         ez = np.zeros(0, np.int64)
 
+    if problem.class_gang is not None:
+        # gang classes share fate (ops/gang.py): OR-fold every member
+        # class's touch row so the union-find below lands the whole gang
+        # in one root — or the whole gang in the residual — and a gang
+        # can never straddle shards.  Sorted gang ids: DT003.
+        cg = np.asarray(problem.class_gang)
+        for g in sorted(int(x) for x in np.unique(cg[cg >= 0])):
+            rows = cg == g
+            touch[rows] = touch[rows].any(axis=0)
+
     ntouch = touch.sum(axis=1)
     residual_mask = (ntouch == 0) | (ntouch > 2)
 

@@ -109,7 +109,8 @@ class SimHarness:
                  device_lp: Optional[bool] = None,
                  ha_failover: Optional[bool] = None,
                  flight_recorder: Optional[bool] = None,
-                 slo: Optional[bool] = None):
+                 slo: Optional[bool] = None,
+                 gang: Optional[bool] = None):
         """`forecast` overrides the scenario's forecast.enabled so A/B
         comparisons (bench, the slow forecast test) can replay one scenario
         twice — knobs still come from the scenario's forecast block.
@@ -141,7 +142,12 @@ class SimHarness:
         (default off): error budgets and the cost ledger run on the
         virtual clock and the report grows gated `slo.budgets`, `ledger`,
         and cost-breakdown sections — every golden is recorded with the
-        gate off."""
+        gate off.  `gang` overrides the GangScheduling gate, else the
+        scenario's `gang.enabled` decides (default off): all-or-nothing
+        gang admission plus priority preemption run in the provisioner
+        and the report grows a gated `gang` section — every golden is
+        recorded with the gate off (time-to-full-gang is tracked either
+        way, so A/B runs can read `_gang_full_t` on the naive side)."""
         if duration_s is not None:
             scenario = replace(scenario, duration_s=float(duration_s))
         scenario.validate()
@@ -186,6 +192,11 @@ class SimHarness:
             if ss is not None:
                 opts.slo_eval_cadence_s = ss.eval_cadence_s
                 opts.ledger_drift_threshold = ss.drift_threshold
+        gs = scenario.gang
+        self._gang_enabled = bool(gang) if gang is not None \
+            else (gs is not None and gs.enabled)
+        if self._gang_enabled:
+            opts.feature_gates["GangScheduling"] = True
         ha = scenario.ha
         self._ha_enabled = bool(ha_failover) if ha_failover is not None \
             else (ha is not None and ha.enabled)
@@ -272,6 +283,13 @@ class SimHarness:
         self.log_entries: List[Dict] = []
         self._arrive_t: Dict[str, float] = {}      # pod uid → arrival time
         self._bind_t: Dict[str, float] = {}        # pod uid → time-to-bind
+        # gang bookkeeping is tracked regardless of the gate so an A/B
+        # run can read time-to-full-gang on the naive (gate-off) side;
+        # only the report section is gated on _gang_enabled
+        self._gang_of: Dict[str, str] = {}         # pod uid → gang name
+        self._gang_members: Dict[str, set] = {}    # gang → member uids
+        self._gang_arrive_t: Dict[str, float] = {}  # gang → first arrival
+        self._gang_full_t: Dict[str, float] = {}   # gang → time to all-bound
         self._departed_unbound = 0
         self._cost_dollar_hours = 0.0
         self._node_hours = 0.0
@@ -353,6 +371,11 @@ class SimHarness:
             now = self.clock.now()
             for p in event.pods:
                 self._arrive_t[p.uid] = now
+                if p.gang_name:
+                    self._gang_of[p.uid] = p.gang_name
+                    self._gang_members.setdefault(
+                        p.gang_name, set()).add(p.uid)
+                    self._gang_arrive_t.setdefault(p.gang_name, now)
             self.cluster.add_pods(event.pods)
         elif isinstance(event, ev.PodDeparture):
             for uid in event.uids:
@@ -361,6 +384,15 @@ class SimHarness:
                     continue
                 if uid not in self._bind_t:
                     self._departed_unbound += 1
+                g = self._gang_of.pop(uid, None)
+                if g is not None:
+                    # departed members shrink the tracked set: a gang
+                    # whose remainder is all bound still counts as full
+                    members = self._gang_members.get(g)
+                    if members is not None:
+                        members.discard(uid)
+                        if not members:
+                            self._gang_members.pop(g, None)
                 self.cluster.delete_pod(pod)
                 self.op.provenance.clear(pod.name)
         elif isinstance(event, ev.SpotReclaim):
@@ -454,6 +486,31 @@ class SimHarness:
             self._log(rec["at"], {"kind": "spot_reclaim_fired",
                                   "instance": rec["instance"],
                                   "honored": honored})
+
+    # ------------------------------------------------------------------
+    def _check_gangs(self) -> None:
+        """Sample gang completeness after each tick: the moment every
+        member of a gang is simultaneously bound on ready (non-booting)
+        nodes, record its time-to-full.  Sampling the cluster beats
+        wrapping every (un)bind path — preemption, reclaim recycling,
+        and consolidation all move pods, and a sample can't miss a
+        transition that persists to the next tick."""
+        if not self._gang_members:
+            return
+        now = self.clock.now()
+        for g in sorted(self._gang_members):
+            if g in self._gang_full_t:
+                continue
+            members = self._gang_members[g]
+            full = True
+            for uid in members:
+                pod = self.cluster.pods.get(uid)
+                if pod is None or not pod.node_name or \
+                        pod.node_name in self._booting:
+                    full = False
+                    break
+            if full and members:
+                self._gang_full_t[g] = now - self._gang_arrive_t[g]
 
     # ------------------------------------------------------------------
     # controller ticking + due-time computation
@@ -585,6 +642,7 @@ class SimHarness:
             for rec in self.cloud.deliver_due():
                 self._on_cloud_delivery(rec)
             self._tick()
+            self._check_gangs()
             self._peak_nodes = max(self._peak_nodes,
                                    len(self.cluster.nodes))
             if now >= t_end:

@@ -213,6 +213,37 @@ def build_report(harness) -> Dict:
         report["cost"]["by_decision_source"] = {
             k: v["realized_dh"]
             for k, v in ledger_sum["by_decision_source"].items()}
+    if getattr(harness, "_gang_enabled", False):
+        # present ONLY when the GangScheduling gate ran — same conditional
+        # contract as forecast/chaos/ha/incidents/slo, so every gate-off
+        # report (all pre-existing goldens) stays byte-identical.  The
+        # time-to-full percentiles come from the harness sampler (virtual
+        # clock); admission/preemption counters from the provisioner's
+        # gang registry, which the sim drives deterministically.
+        fulls: List[float] = sorted(harness._gang_full_t.values())
+        gang_sec: Dict = {
+            "gangs_seen": len(harness._gang_arrive_t),
+            "gangs_full": len(fulls),
+            "time_to_full_gang_s": {
+                "p50": _r(percentile(fulls, 0.50), 3),
+                "p95": _r(percentile(fulls, 0.95), 3),
+                "max": _r(fulls[-1], 3) if fulls else 0.0,
+            },
+        }
+        prov = harness.mgr.controllers.get("provisioning")
+        registry = getattr(prov, "gang_registry", None)
+        if registry is not None:
+            summary = registry.summary()
+            gang_sec["admissions"] = sum(
+                g["admissions"] for g in summary.values())
+            gang_sec["rejections"] = sum(
+                g["rejections"] for g in summary.values())
+            gang_sec["preempted_pods"] = sum(
+                g["preempted"] for g in summary.values())
+            gang_sec["rejected_gangs_at_end"] = sorted(
+                n for n, g in summary.items()
+                if not g["admitted"] and g["rejections"])
+        report["gang"] = gang_sec
     return report
 
 

@@ -95,6 +95,14 @@ class Wave:
     cohorts: int = 1
     every_s: float = 21_600.0
     runtime_s: float = 1_800.0
+    # gang scheduling (docs/gang.md): gang_size > 0 folds each cohort's
+    # pods into consecutive all-or-nothing gangs of that size (pods past
+    # the last full gang stay ungrouped).  Pod shapes draw from the SAME
+    # rng stream as ungrouped waves — adding gang fields never perturbs
+    # sibling randomness or pre-gang goldens.
+    gang_size: int = 0
+    gang_tier: int = 0
+    gang_topology: str = "zone"
 
     def validate(self) -> None:
         if self.kind not in WAVE_KINDS:
@@ -124,6 +132,13 @@ class Wave:
             raise ScenarioError(
                 f"wave {self.name!r}: cohorts, every_s, runtime_s must be "
                 "positive")
+        if self.gang_size < 0 or self.gang_tier < 0:
+            raise ScenarioError(
+                f"wave {self.name!r}: gang_size and gang_tier must be >= 0")
+        if self.gang_topology not in ("zone", "hostname"):
+            raise ScenarioError(
+                f"wave {self.name!r}: gang_topology must be 'zone' or "
+                f"'hostname', got {self.gang_topology!r}")
 
 
 @dataclass
@@ -298,6 +313,20 @@ class SLOSpec:
 
 
 @dataclass
+class GangSpec:
+    """Gang scheduling configuration for a scenario (docs/gang.md).
+    `enabled: true` turns the GangScheduling gate on for the simulated
+    operator — all-or-nothing admission, topology-domain enforcement and
+    tier preemption run over the virtual clock, and the report grows a
+    "gang" section.  The spec lives in the scenario (not a harness flag)
+    so the golden-regeneration one-liner needs no per-case arguments."""
+    enabled: bool = True
+
+    def validate(self) -> None:
+        pass
+
+
+@dataclass
 class Scenario:
     name: str
     duration_s: float = 86_400.0
@@ -323,6 +352,8 @@ class Scenario:
     ha: Optional[HASpec] = None
     # SLO recording rules + cost ledger (None = SLOEngine gate stays off)
     slo: Optional[SLOSpec] = None
+    # gang scheduling (None = GangScheduling gate stays off)
+    gang: Optional[GangSpec] = None
 
     def validate(self) -> None:
         if not self.name:
@@ -350,6 +381,8 @@ class Scenario:
             self.ha.validate()
         if self.slo is not None:
             self.slo.validate()
+        if self.gang is not None:
+            self.gang.validate()
         names = [w.name for w in self.workload]
         if len(set(names)) != len(names):
             raise ScenarioError(f"duplicate wave names: {names}")
@@ -374,6 +407,7 @@ _WAVE_FIELDS = {
     "period_s": float, "phase_s": float, "bucket_s": float,
     "lifetime_s": float, "at_s": float, "count": int, "duration_s": float,
     "cohorts": int, "every_s": float, "runtime_s": float,
+    "gang_size": int, "gang_tier": int, "gang_topology": str,
 }
 _FAULT_FIELDS = {
     "kind": str, "name": str, "at_s": float, "count": int,
@@ -395,6 +429,9 @@ _HA_FIELDS = {
 }
 _SLO_FIELDS = {
     "enabled": bool, "eval_cadence_s": float, "drift_threshold": float,
+}
+_GANG_FIELDS = {
+    "enabled": bool,
 }
 
 
@@ -424,7 +461,7 @@ def scenario_from_dict(doc: Dict) -> Scenario:
         raise ScenarioError(f"scenario document must be a mapping, "
                             f"got {type(doc).__name__}")
     known = {"name", "zones", "intervals", "workload", "faults",
-             "forecast", "chaos", "ha", "slo", *_SCENARIO_SCALARS}
+             "forecast", "chaos", "ha", "slo", "gang", *_SCENARIO_SCALARS}
     for key in doc:
         if key not in known:
             raise ScenarioError(f"unknown scenario field {key!r} "
@@ -511,6 +548,14 @@ def scenario_from_dict(doc: Dict) -> Scenario:
             if key not in _SLO_FIELDS:
                 raise ScenarioError(f"slo: unknown field {key!r}")
         kw["slo"] = SLOSpec(**_coerce("slo", sdoc, _SLO_FIELDS))
+    if doc.get("gang") is not None:
+        gdoc = doc["gang"]
+        if not isinstance(gdoc, dict):
+            raise ScenarioError("gang must be a mapping")
+        for key in gdoc:
+            if key not in _GANG_FIELDS:
+                raise ScenarioError(f"gang: unknown field {key!r}")
+        kw["gang"] = GangSpec(**_coerce("gang", gdoc, _GANG_FIELDS))
     sc = Scenario(**kw)
     sc.validate()
     return sc
@@ -541,8 +586,21 @@ def _make_pod(wave: Wave, name: str, rng: np.random.Generator) -> Pod:
 
 
 def _cohort(wave: Wave, tag: str, n: int, rng: np.random.Generator) -> List[Pod]:
-    return [_make_pod(wave, f"{wave.name}-{tag}-{j:04d}", rng)
+    pods = [_make_pod(wave, f"{wave.name}-{tag}-{j:04d}", rng)
             for j in range(n)]
+    if wave.gang_size > 0:
+        # consecutive full gangs by pod index — deterministic, no extra
+        # rng draws.  The cohort tail past the last full gang stays
+        # ungrouped: a permanently-short gang would be unschedulable by
+        # construction under all-or-nothing admission.
+        full = (n // wave.gang_size) * wave.gang_size
+        for j in range(full):
+            p = pods[j]
+            p.gang_name = f"{wave.name}-{tag}-g{j // wave.gang_size:03d}"
+            p.gang_size = wave.gang_size
+            p.gang_tier = wave.gang_tier
+            p.gang_topology = wave.gang_topology
+    return pods
 
 
 def _expand_wave(wave: Wave, wi: int, sc: Scenario, seed: int

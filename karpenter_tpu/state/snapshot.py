@@ -174,6 +174,14 @@ def collect_sections(op, manager=None) -> Dict:
             led_state = led()
             if led_state is not None:
                 sections["ledger"] = led_state
+        # gang admission registry (GangScheduling gate): same
+        # None-when-off contract — a restart can never observe a
+        # half-admitted gang because admission is atomic pre-bind
+        gang = getattr(manager, "gang_snapshot_state", None)
+        if gang is not None:
+            gang_state = gang()
+            if gang_state is not None:
+                sections["gang"] = gang_state
     sections["meta"] = {
         "version": VERSION,
         "written_at": op.clock(),
@@ -359,6 +367,9 @@ def _apply_sections(sections: Dict, op, manager=None) -> None:
         led = getattr(manager, "ledger_restore_state", None)
         if led is not None and sections.get("ledger") is not None:
             led(sections["ledger"])
+        gang = getattr(manager, "gang_restore_state", None)
+        if gang is not None and sections.get("gang") is not None:
+            gang(sections["gang"])
 
 
 # ---------------------------------------------------------------------------

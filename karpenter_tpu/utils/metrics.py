@@ -1480,3 +1480,50 @@ def ledger_drift_alerts() -> Counter:
         "karpenter_ledger_drift_alerts_total",
         "Cost-drift detector activations, by nodepool.",
         labels=("nodepool",))
+
+
+def gang_admissions() -> Counter:
+    """Gangs admitted whole (every member bound in one solve within one
+    topology domain), by priority tier (GangScheduling, ops/gang.py)."""
+    return REGISTRY.counter(
+        "karpenter_gang_admissions_total",
+        "Gangs admitted all-or-nothing, by priority tier.",
+        labels=("tier",))
+
+
+def gang_rejections() -> Counter:
+    """Gang admission rejections, by reason (`incomplete` — fewer members
+    arrived than declared, `partial` — some members unplaceable,
+    `straddle` — placement crossed topology domains).  A trip family
+    (graftlint OB006): every increment publishes a `gang_rejected`
+    incident in the same function."""
+    return REGISTRY.counter(
+        "karpenter_gang_rejections_total",
+        "Gang admission rejections, by reason.",
+        labels=("reason",))
+
+
+def gang_partial_placeable() -> Gauge:
+    """Gangs whose last solve placed some but not all members — the
+    capacity shortfall signal preemption and operators act on."""
+    return REGISTRY.gauge(
+        "karpenter_gang_partial_placeable",
+        "Gangs currently partially placeable (some members fit).")
+
+
+def gang_preemptions() -> Counter:
+    """Pods evicted on behalf of a waiting higher-tier gang, by the
+    VICTIM's tier (always strictly below the gang's)."""
+    return REGISTRY.counter(
+        "karpenter_gang_preemptions_total",
+        "Pods preempted for higher-tier gangs, by victim tier.",
+        labels=("tier",))
+
+
+def gang_solve_duration() -> Histogram:
+    """Wall time of the post-solve gang admission funnel (audit + strip +
+    preemption planning) per solve."""
+    return REGISTRY.histogram(
+        "karpenter_gang_solve_duration_seconds",
+        "Gang admission audit duration per solve.",
+        buckets=(.0005, .002, .01, .05, .2, 1.0))

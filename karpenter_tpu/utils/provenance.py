@@ -37,6 +37,7 @@ REQUIREMENT = "requirement"     # a user-defined / unmodeled label key or taint
 RESOURCE = "resource"           # a resource dimension exceeds every offering
 CAPACITY = "capacity"           # offerings fit, but launch/limits ran dry
 NO_OFFERINGS = "no-offerings"   # empty catalog / all pools exhausted
+GANG = "gang"                   # all-or-nothing gang admission rejected the pod
 
 _NAMED_KEYS = (
     (wk.INSTANCE_TYPE, INSTANCE_TYPE, "instance_type"),
@@ -108,6 +109,39 @@ def _class_of(problem, pod_idx: int) -> Optional[int]:
 
 def explain_unschedulable(problem, pod_idx: int) -> ProvenanceRecord:
     """First failing requirement/constraint for one unschedulable pod.
+
+    Gang rejections (GangScheduling, ops/gang.py) take precedence: a pod
+    stripped because its gang failed all-or-nothing admission was often
+    individually placeable, so the catalog walk would mislead.  The gang
+    record names the verdict ("gang partially placeable: 7/8"), which
+    members fit, and — for partial gangs — replays the catalog walk on the
+    WORST member (the first unplaced one) to name the constraint that sank
+    the gang."""
+    rej = getattr(problem, "gang_rejections", None)
+    info = rej.get(pod_idx) if rej else None
+    if info is not None:
+        pod = problem.pods[pod_idx]
+        detail = {k: info[k] for k in ("gang", "size", "tier", "topology",
+                                       "arrived", "placed", "placed_members",
+                                       "reason") if k in info}
+        message = info.get("message", "gang rejected")
+        worst = int(info.get("worst", -1))
+        if worst >= 0:
+            wrec = _explain_catalog(problem, worst)
+            detail["worst_member"] = wrec.pod
+            detail["worst_constraint"] = wrec.constraint
+            detail["worst_dimension"] = wrec.dimension
+            message += (f"; worst member {wrec.pod}: {wrec.constraint}"
+                        + (f"/{wrec.dimension}" if wrec.dimension else "")
+                        + f" — {wrec.message}")
+        return ProvenanceRecord(pod=pod.name, constraint=GANG,
+                                dimension=info.get("reason", ""),
+                                message=message, detail=detail)
+    return _explain_catalog(problem, pod_idx)
+
+
+def _explain_catalog(problem, pod_idx: int) -> ProvenanceRecord:
+    """The pre-gang walk: first failing catalog filter for one pod.
 
     Mirrors the tensorizer's filter order (`_CatalogSide.compat_row`): if
     the pod's equivalence class kept a non-empty compat row, the label

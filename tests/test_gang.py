@@ -1,0 +1,411 @@
+"""Gang scheduling suite (GangScheduling gate): all-or-nothing admission
+under randomized fleets, preemption cascade ordering, partition
+fate-sharing, registry durability across restart, and the gate's A/B win
+on time-to-full-gang in the churn-storm scenario."""
+
+import os
+
+import numpy as np
+import pytest
+
+from helpers import cpu_pod, make_type, small_catalog
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import Node, NodePool, Pod
+from karpenter_tpu.api.resources import CPU, MEMORY, ResourceList
+from karpenter_tpu.cloud import CloudProvider, FakeCloud
+from karpenter_tpu.controllers import Provisioner
+from karpenter_tpu.controllers.disruption import pod_disruption_cost
+from karpenter_tpu.ops import tensorize
+from karpenter_tpu.ops.ffd import solve_ffd
+from karpenter_tpu.ops.gang import (GangRegistry, audit_gangs, gang_members,
+                                    plan_preemption, victim_cost)
+from karpenter_tpu.ops.tensorize import GangInfo
+from karpenter_tpu.parallel import plan_partition
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils.provenance import GANG, ProvenanceStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def env(catalog=None, pools=None, provenance=None):
+    cloud = FakeCloud()
+    provider = CloudProvider(cloud, catalog or small_catalog())
+    cluster = Cluster()
+    prov = Provisioner(provider, cluster, pools or [NodePool()],
+                       gang_scheduling=True, provenance=provenance)
+    return cloud, provider, cluster, prov
+
+
+def gang_pod(gang, size, cpu_m=500, mem_mib=512, tier=0, topology="zone",
+             **kw):
+    return Pod(requests=ResourceList({CPU: cpu_m, MEMORY: mem_mib * 2**20}),
+               gang_name=gang, gang_size=size, gang_tier=tier,
+               gang_topology=topology, **kw)
+
+
+def bound_by_gang(cluster):
+    out = {}
+    for p in cluster.pods.values():
+        if p.gang_name and p.node_name:
+            out.setdefault(p.gang_name, []).append(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# all-or-nothing: the core invariant, randomized
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(24))
+def test_all_or_nothing_randomized(seed):
+    """Across randomized fleets, a gang is either fully bound in one
+    topology domain or not bound at all — never a partial bind.  Each
+    fleet mixes placeable gangs, a gang with an unplaceable member
+    (cpu beyond the largest catalog type), an incomplete gang (fewer
+    members arrived than declared), and loose filler pods."""
+    rng = np.random.default_rng([seed, 19])
+    cloud, provider, cluster, prov = env()
+    pods, gangs = [], {}
+    for g in range(int(rng.integers(2, 5))):
+        name = f"g{seed}-{g}"
+        size = int(rng.integers(2, 5))
+        gangs[name] = size
+        for _ in range(size):
+            pods.append(gang_pod(name, size,
+                                 cpu_m=int(rng.integers(200, 2000)),
+                                 mem_mib=int(rng.integers(128, 2048))))
+    # one gang with a member nothing in small_catalog() can hold
+    big = f"g{seed}-big"
+    gangs[big] = 3
+    pods.append(gang_pod(big, 3, cpu_m=64_000))
+    pods.extend(gang_pod(big, 3, cpu_m=int(rng.integers(200, 1000)))
+                for _ in range(2))
+    # one incomplete gang: 2 of 4 declared members arrived
+    short = f"g{seed}-short"
+    gangs[short] = 4
+    pods.extend(gang_pod(short, 4, cpu_m=400) for _ in range(2))
+    pods.extend(cpu_pod(cpu_m=int(rng.integers(100, 1500)))
+                for _ in range(int(rng.integers(0, 8))))
+    order = rng.permutation(len(pods))
+    cluster.add_pods([pods[i] for i in order])
+    prov.provision()
+    by_gang = bound_by_gang(cluster)
+    arrived = {}
+    for p in cluster.pods.values():
+        if p.gang_name:
+            arrived[p.gang_name] = arrived.get(p.gang_name, 0) + 1
+    for name, n in arrived.items():
+        bound = by_gang.get(name, [])
+        assert len(bound) in (0, n), (
+            f"partial gang bind: {name} has {len(bound)}/{n} members bound")
+        zones = {cluster.nodes[p.node_name].zone for p in bound}
+        assert len(zones) <= 1, f"gang {name} straddles zones {zones}"
+    assert not by_gang.get(big), "gang with an unplaceable member was bound"
+    assert not by_gang.get(short), "incomplete gang was bound"
+
+
+def test_admitted_gang_binds_whole():
+    """The happy path: a placeable gang binds every member, same zone."""
+    cloud, provider, cluster, prov = env()
+    cluster.add_pods([gang_pod("train", 3, cpu_m=700) for _ in range(3)])
+    prov.provision()
+    bound = bound_by_gang(cluster).get("train", [])
+    assert len(bound) == 3
+    assert len({cluster.nodes[p.node_name].zone for p in bound}) == 1
+
+
+def test_rejection_strips_gang_but_not_neighbors():
+    """A rejected gang never blocks the loose pods solved alongside it,
+    and `PackingResult.strip_pods` returns every member as pending."""
+    cloud, provider, cluster, prov = env()
+    cluster.add_pods([gang_pod("bad", 2, cpu_m=64_000),
+                      gang_pod("bad", 2, cpu_m=300),
+                      cpu_pod(cpu_m=400), cpu_pod(cpu_m=600)])
+    prov.provision()
+    assert not bound_by_gang(cluster).get("bad")
+    pending = {p.gang_name for p in cluster.pending_pods()}
+    assert pending == {"bad"}
+    loose = [p for p in cluster.pods.values() if not p.gang_name]
+    assert all(p.node_name for p in loose)
+
+
+def test_gang_provenance_names_worst_member():
+    """explain_unschedulable reports the gang step: the partial count and
+    the first failing constraint of the worst member."""
+    store = ProvenanceStore()
+    cloud, provider, cluster, prov = env(provenance=store)
+    cluster.add_pods([gang_pod("half", 2, cpu_m=64_000),
+                      gang_pod("half", 2, cpu_m=300)])
+    prov.provision()
+    recs = [r for r in store.all() if r.constraint == GANG]
+    assert recs, "no gang provenance recorded"
+    rec = recs[0]
+    assert rec.dimension == "partial"
+    assert "gang partially placeable: 1/2" in rec.message
+    assert "worst member" in rec.message
+    assert rec.detail["gang"] == "half"
+    assert rec.detail["worst_constraint"] == "resource"
+
+
+# ---------------------------------------------------------------------------
+# preemption cascade
+# ---------------------------------------------------------------------------
+
+def _node(name, zone, cpu_m, mem_mib, pods=()):
+    alloc = ResourceList({CPU: cpu_m, MEMORY: mem_mib * 2**20})
+    n = Node(name=name, zone=zone, allocatable=alloc, capacity=alloc,
+             pods=list(pods))
+    for p in n.pods:
+        p.node_name = name
+    return n
+
+
+def _victimable(uid, cpu_m, tier=0, priority=0, **kw):
+    p = Pod(name=uid, requests=ResourceList(
+        {CPU: cpu_m, MEMORY: 256 * 2**20}), gang_tier=tier,
+        priority=priority, **kw)
+    p.uid = uid
+    return p
+
+
+def test_victim_cost_matches_disruption_formula():
+    """ops/gang.victim_cost mirrors controllers/disruption.
+    pod_disruption_cost (ops must not import controllers) — this pin is
+    the only thing keeping the two formulas identical."""
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        p = Pod(requests=ResourceList({CPU: 100}),
+                priority=int(rng.integers(-10, 10_000)))
+        p.deletion_cost = int(rng.integers(0, 1000))
+        assert victim_cost(p) == pod_disruption_cost(p)
+
+
+def test_preemption_cascade_ordering_and_minimality():
+    """Victims come strictly from lower tiers, ordered (tier asc, cost
+    asc, uid), and form a minimal prefix: dropping the last victim must
+    leave the gang infeasible."""
+    victims = [_victimable(f"v{i:02d}", cpu_m=900, tier=i % 2,
+                           priority=100 * i) for i in range(8)]
+    same_tier = [_victimable(f"w{i:02d}", cpu_m=900, tier=2)
+                 for i in range(2)]
+    nodes = [_node(f"n{i}", "zone-a", 2000, 4096,
+                   pods=[victims[2 * i], victims[2 * i + 1]])
+             for i in range(4)]
+    nodes.append(_node("n9", "zone-a", 2000, 4096, pods=same_tier))
+    gang = GangInfo(name="slice", size=3, tier=2, topology="zone")
+    reqs = [ResourceList({CPU: 1800, MEMORY: 1024 * 2**20})] * 3
+    plan = plan_preemption(gang, reqs, nodes)
+    assert plan is not None and plan.victims
+    tiers = [v.tier for v in plan.victims]
+    assert all(t < gang.tier for t in tiers), "victim at or above gang tier"
+    assert not any(v.uid.startswith("w") for v in plan.victims)
+    keys = [(v.tier, v.cost, v.uid) for v in plan.victims]
+    assert keys == sorted(keys), "cascade out of (tier, cost, uid) order"
+    # minimality: the prefix one victim shorter must not be feasible —
+    # re-plan against nodes with all but the last victim already gone
+    last = plan.victims[-1]
+    for n in nodes:
+        n.pods = [p for p in n.pods
+                  if p.uid == last.uid or
+                  p.uid not in {v.uid for v in plan.victims}]
+    replay = plan_preemption(gang, reqs, nodes)
+    assert replay is not None and [v.uid for v in replay.victims] == [last.uid]
+
+
+def test_preemption_spares_protected_pods():
+    """Daemons, do-not-disrupt pods, and ownerless pods are never victims."""
+    protected = [
+        _victimable("daemon", 900, owner_kind="DaemonSet"),
+        _victimable("pinned", 900,
+                    annotations={"karpenter.sh/do-not-disrupt": "true"}),
+        _victimable("bare", 900, owner_kind=""),
+    ]
+    nodes = [_node("n0", "zone-a", 2000, 4096, pods=protected[:2]),
+             _node("n1", "zone-a", 2000, 4096, pods=protected[2:])]
+    gang = GangInfo(name="slice", size=2, tier=1, topology="zone")
+    reqs = [ResourceList({CPU: 1800, MEMORY: 512 * 2**20})] * 2
+    assert plan_preemption(gang, reqs, nodes) is None
+
+
+def test_preemption_respects_pinned_domains():
+    """A gang with bound residents must free room where they live, even
+    when another domain offers a cheaper plan."""
+    nodes = [_node("na", "zone-a", 2000, 4096,
+                   pods=[_victimable("a0", 900), _victimable("a1", 900)]),
+             _node("nb", "zone-b", 2000, 4096,
+                   pods=[_victimable("b0", 1800)])]
+    gang = GangInfo(name="slice", size=2, tier=1, topology="zone")
+    reqs = [ResourceList({CPU: 1700, MEMORY: 512 * 2**20})]
+    free = plan_preemption(gang, reqs, nodes)
+    assert free is not None and free.domain == "zone-b"  # one victim, not two
+    pinned = plan_preemption(gang, reqs, nodes, pin_domains=("zone-a",))
+    assert pinned is not None and pinned.domain == "zone-a"
+    assert sorted(v.uid for v in pinned.victims) == ["a0", "a1"]
+
+
+def test_preemption_is_per_node_not_aggregate():
+    """A domain with plenty of TOTAL free capacity but no single node
+    large enough must still evict: aggregate arithmetic would return an
+    empty plan that frees nothing the solver can use."""
+    # 4 nodes, each 1000m free: 4000m aggregate, but a 1800m member
+    # fits nowhere until a victim dies
+    nodes = [_node(f"n{i}", "zone-a", 2000, 4096,
+                   pods=[_victimable(f"v{i}", 1000)]) for i in range(4)]
+    gang = GangInfo(name="slice", size=1, tier=1, topology="zone")
+    reqs = [ResourceList({CPU: 1800, MEMORY: 512 * 2**20})]
+    plan = plan_preemption(gang, reqs, nodes)
+    assert plan is not None and len(plan.victims) == 1
+
+
+# ---------------------------------------------------------------------------
+# tensorize + partition fate-sharing
+# ---------------------------------------------------------------------------
+
+ZONES = tuple(f"zone-{c}" for c in "abcd")
+
+
+def _zoned_catalog():
+    return [make_type("a.small", 2, 4, 0.10, zones=ZONES),
+            make_type("a.large", 8, 16, 0.40, zones=ZONES)]
+
+
+def test_gang_never_straddles_partition_shard():
+    """Union-find fate-sharing: a gang whose members pin to different
+    zones lands whole in one shard (or whole in the residual) — the
+    all-or-nothing audit needs the full gang in one packing."""
+    pods = [cpu_pod(cpu_m=500, mem_mib=256, node_selector={wk.ZONE: z})
+            for z in ZONES for _ in range(40)]
+    # a gang split across zone-a and zone-b pins those groups together
+    for i, z in enumerate(("zone-a", "zone-b")):
+        pods.append(gang_pod("bridge", 2, cpu_m=500,
+                             node_selector={wk.ZONE: z}))
+    prob = tensorize(pods, _zoned_catalog(), [NodePool()])
+    assert prob.class_gang is not None
+    plan = plan_partition(prob, 4, min_pods=1)
+    assert plan is not None
+    members = np.nonzero(np.asarray(prob.class_gang) >= 0)[0]
+    shards = {int(plan.class_shard[ci]) for ci in members}
+    assert len(shards) == 1, f"gang classes split across shards {shards}"
+
+
+def test_class_order_groups_gang_adjacently():
+    """Gang member classes are contiguous in class_order (at the rank of
+    the gang's largest class) so one packing scan sees the whole gang."""
+    pods = [cpu_pod(cpu_m=1900), cpu_pod(cpu_m=100),
+            gang_pod("g", 2, cpu_m=1500),
+            gang_pod("g", 2, cpu_m=200)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    order = prob.class_order().tolist()
+    gang_classes = np.nonzero(np.asarray(prob.class_gang) >= 0)[0].tolist()
+    positions = sorted(order.index(ci) for ci in gang_classes)
+    assert positions == list(range(positions[0],
+                                   positions[0] + len(positions)))
+
+
+def test_no_gang_class_order_unchanged():
+    """Without gangs the order is byte-identical to the pre-gang sort."""
+    rng = np.random.default_rng(3)
+    pods = [cpu_pod(cpu_m=int(rng.integers(100, 2000)),
+                    mem_mib=int(rng.integers(128, 2048)))
+            for _ in range(30)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    assert prob.class_gang is None
+    norm = prob.option_alloc.mean(axis=0)
+    norm = np.where(norm > 0, norm, 1.0)
+    size = (prob.class_requests / norm).max(axis=1)
+    np.testing.assert_array_equal(
+        prob.class_order(), np.argsort(-size, kind="stable"))
+
+
+def test_strip_pods_rebalances_result():
+    """strip_pods removes members from decisions, shrinks used vectors,
+    drops emptied nodes, and re-sums the price."""
+    pods = [cpu_pod(cpu_m=1500, mem_mib=1024) for _ in range(3)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    result = solve_ffd(prob)
+    placed = sorted(i for d in result.nodes for i in d.pod_indices)
+    assert placed == [0, 1, 2]
+    before_price = result.total_price
+    result.strip_pods({0, 1}, pods=prob.pods)
+    left = sorted(i for d in result.nodes for i in d.pod_indices)
+    assert left == [2]
+    assert sorted(result.unschedulable) == [0, 1]
+    assert result.total_price <= before_price
+    assert all(d.pod_indices for d in result.nodes)
+
+
+# ---------------------------------------------------------------------------
+# registry durability + restart atomicity
+# ---------------------------------------------------------------------------
+
+def test_registry_snapshot_roundtrip():
+    reg = GangRegistry()
+    pods = [gang_pod("a", 2, cpu_m=300) for _ in range(2)]
+    prob = tensorize(pods, small_catalog(), [NodePool()])
+    result = solve_ffd(prob)
+    for audit in audit_gangs(prob, result, []):
+        reg.observe(audit)
+    reg.record_preemption("a", 3)
+    state = reg.snapshot_state()
+    reg2 = GangRegistry()
+    reg2.restore_state(state)
+    assert reg2.summary() == reg.summary()
+    assert reg2.get("a").preempted == 3
+
+
+def test_restart_never_surfaces_half_admitted_gang(tmp_path):
+    """kill -9 atomicity: a snapshot taken at any point, restored into a
+    fresh stack, shows every gang fully bound or fully pending — plus the
+    registry section round-trips through state/snapshot.py."""
+    from test_snapshot import stack
+    from karpenter_tpu.state.snapshot import restore_snapshot, write_snapshot
+
+    clk = [1000.0]
+    path = str(tmp_path / "snap.bin")
+    gates = ("WarmRestart", "GangScheduling")
+    op, mgr = stack(lambda: clk[0], path, gates)
+    op.cluster.add_pods(
+        [gang_pod("ok", 3, cpu_m=600) for _ in range(3)]
+        + [gang_pod("doomed", 2, cpu_m=10_000_000)]  # forever partial
+        + [gang_pod("doomed", 2, cpu_m=400)]
+        + [cpu_pod(cpu_m=500) for _ in range(3)])
+    for _ in range(3):
+        mgr.tick()
+        clk[0] += 1.1
+    reg = mgr.controllers["provisioning"].gang_registry
+    assert reg.get("ok") is not None and reg.get("ok").admitted
+    assert reg.get("doomed") is not None and not reg.get("doomed").admitted
+    assert write_snapshot(path, op, mgr)
+
+    op2, mgr2 = stack(lambda: clk[0], path, gates)
+    assert restore_snapshot(path, op2, mgr2) == "restored"
+    by_gang = bound_by_gang(op2.cluster)
+    assert len(by_gang.get("ok", [])) == 3
+    assert "doomed" not in by_gang, "restart surfaced a half-admitted gang"
+    reg2 = mgr2.controllers["provisioning"].gang_registry
+    assert reg2.summary() == reg.summary()
+
+
+# ---------------------------------------------------------------------------
+# the A/B: gang-aware beats naive on time-to-full-gang
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_gang_ab_beats_naive_on_time_to_full():
+    """Replaying gang-churn-storm with the gate ON (preemption frees
+    room inside the ICE windows) must beat the naive gate-OFF replay on
+    time-to-full-gang p95 — and complete every gang it saw."""
+    from karpenter_tpu.sim import SimHarness, load_scenario
+    from karpenter_tpu.sim.report import percentile
+
+    sc = load_scenario(os.path.join(REPO, "scenarios",
+                                    "gang-churn-storm.yaml"))
+    on = SimHarness(sc, seed=0)
+    on.run()
+    off = SimHarness(sc, seed=0, gang=False)
+    off.run()
+    assert set(on._gang_full_t) == set(on._gang_arrive_t), \
+        "gate-on left a gang incomplete"
+    p95_on = percentile(sorted(on._gang_full_t.values()), 0.95)
+    p95_off = percentile(sorted(off._gang_full_t.values()), 0.95)
+    assert p95_on < p95_off, (
+        f"gang-aware p95 {p95_on:.0f}s did not beat naive {p95_off:.0f}s")

@@ -255,7 +255,20 @@ GOLDEN_CASES = [
     # the golden (the two-process kill -9 drill lives in
     # tests/test_failover.py)
     ("failover-drill", "failover-drill.yaml", 5400.0),
+    # gang scheduling: the scenarios' `gang:` block turns the
+    # GangScheduling gate on, so the report's gated "gang" section
+    # (admissions, preemptions, time_to_full_gang_s) is part of the
+    # golden; the naive-baseline replay is test_golden_report_gang_gate_off
+    ("gang-churn-storm", "gang-churn-storm.yaml", 7200.0),
+    ("mixed-priority-diurnal", "mixed-priority-diurnal.yaml", 12600.0),
 ]
+
+# scenarios recorded before the GangScheduling gate existed — the
+# gate-off identity test replays exactly these, proving the gang layer
+# is invisible when off (the two gang scenarios above turn it on)
+PRE_GANG_CASES = [c for c in GOLDEN_CASES
+                  if c[1] not in {"gang-churn-storm.yaml",
+                                  "mixed-priority-diurnal.yaml"}]
 
 
 @pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
@@ -327,6 +340,23 @@ def test_golden_report_durability_gates_off(name, fname, duration):
     with open(path) as fh:
         assert got == fh.read(), (
             f"durability-gates-off report for {fname} diverged from {path}")
+
+
+@pytest.mark.parametrize("name,fname,duration", PRE_GANG_CASES,
+                         ids=[c[0] for c in PRE_GANG_CASES])
+def test_golden_report_gang_gate_off(name, fname, duration):
+    """GangScheduling defaults OFF; the explicit off-override must leave
+    every pre-gang scenario's report byte-identical — no gang columns, no
+    audit, no registry, no report section.  (The two gang scenarios are
+    excluded: their `gang:` block exists to turn the gate ON.)"""
+    sc = load_scenario(os.path.join(SCENARIOS, fname))
+    run = SimHarness(sc, seed=0, duration_s=duration, gang=False).run()
+    got = report_to_json(run.report)
+    path = os.path.join(GOLDEN, f"sim-{name}.json")
+    with open(path) as fh:
+        assert got == fh.read(), (
+            f"gang=off report for {fname} diverged from {path}: the gang "
+            f"layer leaked into a run that never enabled it")
 
 
 @pytest.mark.parametrize("name,fname,duration", GOLDEN_CASES,
